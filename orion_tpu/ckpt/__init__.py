@@ -1,0 +1,10 @@
+"""Checkpoint/resume (reference ``orion.checkpoint`` equivalent).
+
+BASELINE.json:5 prescribes the mapping: orion.checkpoint moves to Orbax —
+async, sharded saves via tensorstore, restore into the same NamedShardings
+(SURVEY.md §4 stack E).
+"""
+
+from orion_tpu.ckpt.checkpoint import CheckpointManager
+
+__all__ = ["CheckpointManager"]

@@ -1,0 +1,68 @@
+"""Orbax-backed checkpoint manager.
+
+Wraps ``orbax.checkpoint.CheckpointManager``: async sharded saves (each host
+writes its own shards via tensorstore), retention/GC, and restore into an
+abstract sharded target so a 70B state never materializes unsharded
+(SURVEY.md §4 stack E). The data iterator needs no state here — loaders are
+pure functions of the step (see orion_tpu.data).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+from orion_tpu.config import CheckpointConfig
+
+log = logging.getLogger("orion_tpu.ckpt")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, cfg: CheckpointConfig):
+        self.cfg = cfg
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=cfg.max_to_keep,
+                save_interval_steps=cfg.save_interval_steps,
+                enable_async_checkpointing=cfg.async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save if the step matches the save interval (or force)."""
+        if step in self._mgr.all_steps():
+            return False
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            log.info("checkpoint saved at step %d", step)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, abstract_state: Any) -> Optional[tuple[Any, int]]:
+        """Restore the newest checkpoint into the abstract target's shardings.
+
+        Returns (state, step) or None if no checkpoint exists.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+        log.info("restored checkpoint from step %d", step)
+        return state, step
+
+    def wait(self) -> None:
+        """Block until async saves land (call before process exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

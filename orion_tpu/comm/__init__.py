@@ -1,0 +1,35 @@
+"""Collective communication surface.
+
+TPU-native replacement for the reference's ``orion.distributed`` collective
+wrappers (all-reduce / all-gather / reduce-scatter over NCCL; SURVEY.md §1,
+§6 "Distributed communication backend"). There is no external comm library:
+every call here lowers to an XLA collective that rides ICI within a slice and
+DCN across slices, chosen by the mesh. Upper layers use these typed wrappers
+instead of raw ``lax`` so the comm surface is a single, testable module.
+"""
+
+from orion_tpu.comm.collectives import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    axis_size,
+    barrier,
+    broadcast,
+    ppermute,
+    reduce_scatter,
+    ring_shift,
+)
+
+__all__ = [
+    "all_gather",
+    "all_reduce",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+    "barrier",
+    "broadcast",
+    "ppermute",
+    "reduce_scatter",
+    "ring_shift",
+]

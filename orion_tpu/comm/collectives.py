@@ -1,0 +1,117 @@
+"""Typed collective wrappers over XLA, for use inside ``shard_map``.
+
+Each function corresponds to one reference collective (NCCL verbs named in
+BASELINE.json:5 plus all-to-all from the MoE path, BASELINE.json:10):
+
+    NCCL verb            | wrapper        | XLA primitive
+    ---------------------|----------------|--------------------------
+    ncclAllReduce        | all_reduce     | lax.psum / pmax / pmin
+    ncclAllGather        | all_gather     | lax.all_gather
+    ncclReduceScatter    | reduce_scatter | lax.psum_scatter
+    ncclAllToAll (p2p)   | all_to_all     | lax.all_to_all
+    ncclSend/Recv ring   | ppermute       | lax.ppermute
+    ncclBroadcast        | broadcast      | psum of masked operand
+    barrier              | barrier        | tiny psum
+
+All take ``axis`` (a mesh axis name or tuple of names) and must be called
+inside ``shard_map``/``pjit``-traced code over a mesh binding those axes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Sequence[str]]
+
+
+def axis_size(axis: Axis) -> int:
+    """Number of devices along a (possibly composite) mesh axis."""
+    return lax.axis_size(axis)
+
+
+def axis_index(axis: Axis) -> jax.Array:
+    """This device's coordinate along the axis."""
+    return lax.axis_index(axis)
+
+
+def all_reduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
+    """Reduce ``x`` across the axis onto every member (NCCL allreduce)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(
+    x: jax.Array, axis: Axis, *, gather_axis: int = 0, tiled: bool = True
+) -> jax.Array:
+    """Concatenate per-device shards along ``gather_axis`` (NCCL allgather).
+
+    tiled=True returns shape with dim ``gather_axis`` multiplied by the axis
+    size (the NCCL layout); tiled=False stacks a new leading device dim.
+    """
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(
+    x: jax.Array, axis: Axis, *, scatter_axis: int = 0
+) -> jax.Array:
+    """Sum across devices, then leave each with one shard (reduce-scatter)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def all_to_all(
+    x: jax.Array,
+    axis: Axis,
+    *,
+    split_axis: int,
+    concat_axis: int,
+) -> jax.Array:
+    """Transpose a dimension across devices (NCCL alltoall).
+
+    Splits ``split_axis`` into axis_size pieces, sends piece i to device i,
+    concatenates received pieces along ``concat_axis``. The EP dispatch /
+    Ulysses head<->sequence reshard primitive.
+    """
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def ppermute(
+    x: jax.Array, axis: Axis, perm: Sequence[tuple[int, int]]
+) -> jax.Array:
+    """Point-to-point permutation (NCCL send/recv). perm: (src, dst) pairs."""
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def ring_shift(x: jax.Array, axis: Axis, *, shift: int = 1) -> jax.Array:
+    """Rotate shards around the axis ring — the ring-attention KV step."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def broadcast(x: jax.Array, axis: Axis, *, root: int = 0) -> jax.Array:
+    """Every member receives root's value (NCCL broadcast)."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def barrier(axis: Axis) -> jax.Array:
+    """Synchronization point: completes only when all members arrive.
+
+    Returns the axis size (a cheap psum of ones); callers can ignore it or
+    use it as a data dependency to order side effects.
+    """
+    return lax.psum(jnp.ones((), jnp.int32), axis)

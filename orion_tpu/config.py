@@ -1,0 +1,511 @@
+"""Typed configuration tree for orion-tpu.
+
+The reference stack (``DatCorno/orion``) drives its ``train.py`` from a config /
+flag system (SURVEY.md §6 "Config / flag system"); this module is the TPU-native
+equivalent: a tree of frozen dataclasses (model / optimizer / train / parallel /
+data / checkpoint / inference / runtime), a preset registry covering the five
+baseline workloads (BASELINE.json configs 1-5), and dotted ``key=value`` CLI
+overrides so every experiment is reproducible from a single command line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from dataclasses import dataclass, field, fields, replace
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Leaf configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of a decoder-only transformer.
+
+    One parameterization covers the whole model zoo (SURVEY.md §3 "models"):
+    GPT-2 (learned positions, LayerNorm, GELU), Llama-3 (RoPE, RMSNorm,
+    SwiGLU, GQA) and Mixtral (Llama + top-k MoE).
+    """
+
+    name: str = "model"
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int = 12            # < n_heads => grouped-query attention
+    d_ff: int = 3072
+    head_dim: Optional[int] = None  # default: d_model // n_heads
+
+    # Positional / norm / activation family switches.
+    pos_embedding: str = "rope"     # "rope" | "learned"
+    rope_theta: float = 500_000.0
+    norm: str = "rmsnorm"           # "rmsnorm" | "layernorm"
+    norm_eps: float = 1e-5
+    activation: str = "swiglu"      # "swiglu" | "gelu"
+    tie_embeddings: bool = True
+    attn_bias: bool = False
+    mlp_bias: bool = False
+    attn_logit_softcap: Optional[float] = None
+
+    # Mixture-of-experts (0 experts => dense MLP).
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    # Token capacity per expert = capacity_factor * tokens / n_experts.
+    capacity_factor: float = 1.25
+    router_aux_loss_weight: float = 0.01
+
+    # Numerics.
+    dtype: str = "bfloat16"         # activation / weight compute dtype
+    param_dtype: str = "float32"    # master parameter dtype
+
+    # Kernel selection: "pallas" uses the fused TPU kernels in orion_tpu.ops,
+    # "xla" uses the pure-jnp reference path (also the CPU/test path).
+    kernels: str = "xla"
+
+    # Gradient checkpointing policy for the layer scan:
+    # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
+    remat: str = "none"
+
+    # Layers are evaluated with lax.scan over stacked per-layer params.
+    scan_layers: bool = True
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + norms)."""
+        h, v, L = self.d_model, self.vocab_size, self.n_layers
+        hd = self.resolved_head_dim
+        q = h * self.n_heads * hd
+        kv = 2 * h * self.n_kv_heads * hd
+        o = self.n_heads * hd * h
+        attn = q + kv + o
+        if self.activation == "swiglu":
+            mlp = 3 * h * self.d_ff
+        else:
+            mlp = 2 * h * self.d_ff
+        if self.is_moe:
+            mlp = mlp * self.n_experts + h * self.n_experts  # experts + router
+        norms = 2 * h
+        block = attn + mlp + norms
+        embed = v * h if self.tie_embeddings else 2 * v * h
+        pos = self.max_seq_len * h if self.pos_embedding == "learned" else 0
+        return embed + pos + L * block + h
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Training FLOPs per token: 6*N_active plus the attention term.
+
+        Used for the judged MFU metric (BASELINE.json:2); matches the standard
+        6*N + 12*L*H*Q*T accounting (PaLM appendix-style).
+        """
+        s = seq_len if seq_len is not None else self.max_seq_len
+        h, L = self.d_model, self.n_layers
+        hd = self.resolved_head_dim
+        attn = self.n_heads * hd * h + 2 * self.n_kv_heads * hd * h + self.n_heads * hd * h
+        if self.activation == "swiglu":
+            mlp = 3 * h * self.d_ff
+        else:
+            mlp = 2 * h * self.d_ff
+        if self.is_moe:
+            mlp = mlp * self.n_experts_per_token
+        dense_flops = 6.0 * L * (attn + mlp) + 6.0 * self.vocab_size * h
+        # Attention score/value FLOPs: 12 * L * heads * head_dim * seq.
+        attn_flops = 12.0 * L * self.n_heads * hd * s
+        return dense_flops + attn_flops
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 3e-4
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 100
+    decay_steps: Optional[int] = None   # default: train.num_steps
+    schedule: str = "cosine"            # "cosine" | "linear" | "constant"
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    grad_clip_norm: float = 1.0
+    # Dtype of Adam moments; bf16 halves optimizer HBM at slight quality cost.
+    moment_dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh axis sizes. Product must equal the total device count.
+
+    Axis semantics (SURVEY.md §2/§6):
+      dp    - pure data parallelism (replicated params, psum grads)
+      fsdp  - ZeRO-3 data parallelism (params/grads/opt sharded, gather-on-use)
+      tp    - tensor parallelism (heads / mlp hidden sharded)
+      pp    - pipeline stages
+      sp    - sequence/context parallelism (ring attention / Ulysses)
+      ep    - expert parallelism (MoE experts sharded, all_to_all dispatch)
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    # Attention algorithm when sp > 1: "ring" | "ulysses".
+    sequence_method: str = "ring"
+    # Pipeline microbatches (pp > 1). Must divide the per-step batch.
+    pp_microbatches: int = 1
+    # Mesh axes that live on DCN (multi-slice); all others ride ICI.
+    dcn_axes: Tuple[str, ...] = ()
+
+    @property
+    def axis_sizes(self) -> Mapping[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                "pp": self.pp, "sp": self.sp, "ep": self.ep}
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in self.axis_sizes.values():
+            n *= v
+        return n
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    source: str = "synthetic"       # "synthetic" | "memmap" | "hf"
+    path: Optional[str] = None       # token file (memmap) or dataset name (hf)
+    batch_size: int = 8              # global batch, in sequences
+    seq_len: int = 1024
+    shuffle_seed: int = 0
+    num_epochs: Optional[int] = None
+    # Native (C++) loader for memmap token shards; falls back to numpy.
+    use_native_loader: bool = True
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    directory: Optional[str] = None
+    save_interval_steps: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+    restore: bool = True             # restore_or_init on startup
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    num_steps: int = 1000
+    log_interval: int = 10
+    seed: int = 0
+    # Gradient accumulation: data.batch_size is the global batch per optimizer
+    # step; grad_accum splits it into that many sequential microbatches (must
+    # divide batch_size). Token throughput is unaffected; memory shrinks.
+    grad_accum: int = 1
+    # Profiling window (jax.profiler trace), e.g. (10, 20). None disables.
+    profile_steps: Optional[Tuple[int, int]] = None
+    profile_dir: str = "/tmp/orion_tpu_profile"
+    # Fault injection for recovery tests: raise at this step (SURVEY.md §6).
+    inject_fault_at_step: Optional[int] = None
+    # Device peak bf16 FLOP/s for MFU; None => autodetect from device kind.
+    peak_flops_per_device: Optional[float] = None
+    metrics_jsonl: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class InferenceConfig:
+    max_seq_len: int = 2048
+    page_size: int = 64               # tokens per KV-cache page
+    num_pages: int = 512              # global page pool size
+    max_batch_size: int = 32          # max concurrent sequences
+    prefill_chunk: int = 512          # prefill bucketing
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 128
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    # jax.distributed coordination (multi-host). None => single-process.
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    # Force a backend ("cpu" for fake-device testing); None = default (TPU).
+    platform: Optional[str] = None
+    deterministic: bool = False       # bitwise-reproducible mode
+    debug_nans: bool = False          # TPU-native sanitizer (SURVEY.md §6)
+
+
+@dataclass(frozen=True)
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=str)
+
+
+# ---------------------------------------------------------------------------
+# Overrides:  dotted key=value strings, e.g.  model.n_layers=4 data.batch_size=2
+# ---------------------------------------------------------------------------
+
+
+def _parse_value(raw: str, target_type: Any) -> Any:
+    if raw.lower() in ("none", "null"):
+        return None
+    origin = typing.get_origin(target_type)
+    if origin is typing.Union:  # Optional[X] / Union[X, None] -> X
+        non_none = [a for a in typing.get_args(target_type) if a is not type(None)]
+        return _parse_value(raw, non_none[0])
+    if origin is tuple or target_type is tuple:
+        if not raw:
+            return ()
+        if raw.startswith("["):
+            return tuple(json.loads(raw))
+        return tuple(_auto(v) for v in raw.split(","))
+    if target_type is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(raw)
+    if target_type is float:
+        return float(raw)
+    return raw
+
+
+def _auto(raw: str) -> Any:
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except ValueError:
+            pass
+    return raw
+
+
+def apply_overrides(cfg: Config, overrides: Sequence[str]) -> Config:
+    """Apply ``section.key=value`` overrides to a Config, returning a new one."""
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override must be key=value, got {item!r}")
+        key, raw = item.split("=", 1)
+        parts = key.split(".")
+        cfg = _apply_one(cfg, parts, raw)
+    return cfg
+
+
+def _apply_one(node: Any, parts: Sequence[str], raw: str) -> Any:
+    name = parts[0]
+    if name not in {f.name for f in fields(node)}:
+        valid = ", ".join(f.name for f in fields(node))
+        raise ValueError(f"unknown config key {name!r}; valid: {valid}")
+    if len(parts) == 1:
+        # `from __future__ import annotations` stringifies f.type; resolve the
+        # real type object so Optional[int] etc. parse correctly.
+        hints = typing.get_type_hints(type(node))
+        try:
+            value = _parse_value(raw, hints[name])
+        except ValueError as e:
+            raise ValueError(f"bad value for config key {name!r}: {e}") from e
+        return replace(node, **{name: value})
+    return replace(node, **{name: _apply_one(getattr(node, name), parts[1:], raw)})
+
+
+# ---------------------------------------------------------------------------
+# Preset registry — the five baseline workloads (BASELINE.json:6-12) plus
+# small variants for tests and the single-chip dev box.
+# ---------------------------------------------------------------------------
+
+_PRESETS: dict[str, Callable[[], Config]] = {}
+
+
+def register_preset(name: str):
+    def deco(fn: Callable[[], Config]):
+        _PRESETS[name] = fn
+        return fn
+    return deco
+
+
+def get_config(preset: str, overrides: Sequence[str] = ()) -> Config:
+    if preset not in _PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; have: {sorted(_PRESETS)}")
+    return apply_overrides(_PRESETS[preset](), overrides)
+
+
+def list_presets() -> Sequence[str]:
+    return sorted(_PRESETS)
+
+
+def _gpt2_model(**kw) -> ModelConfig:
+    base = dict(
+        name="gpt2-125m", vocab_size=50304, max_seq_len=1024,
+        d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
+        pos_embedding="learned", norm="layernorm", norm_eps=1e-5,
+        activation="gelu", tie_embeddings=True, attn_bias=True, mlp_bias=True,
+        dtype="float32", kernels="xla",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _llama3_8b_model(**kw) -> ModelConfig:
+    base = dict(
+        name="llama3-8b", vocab_size=128256, max_seq_len=8192,
+        d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        pos_embedding="rope", rope_theta=500_000.0, norm="rmsnorm",
+        norm_eps=1e-5, activation="swiglu", tie_embeddings=False,
+        dtype="bfloat16", kernels="xla", remat="full",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _llama3_70b_model(**kw) -> ModelConfig:
+    base = dict(
+        name="llama3-70b", vocab_size=128256, max_seq_len=8192,
+        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
+        pos_embedding="rope", rope_theta=500_000.0, norm="rmsnorm",
+        norm_eps=1e-5, activation="swiglu", tie_embeddings=False,
+        dtype="bfloat16", kernels="xla", remat="full",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _mixtral_model(**kw) -> ModelConfig:
+    base = dict(
+        name="mixtral-8x7b", vocab_size=32000, max_seq_len=4096,
+        d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        pos_embedding="rope", rope_theta=1_000_000.0, norm="rmsnorm",
+        norm_eps=1e-5, activation="swiglu", tie_embeddings=False,
+        n_experts=8, n_experts_per_token=2,
+        dtype="bfloat16", kernels="xla", remat="full",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@register_preset("gpt2-125m")
+def _p_gpt2() -> Config:
+    """Baseline config 1: GPT-2 125M single-device CPU-runnable smoke test."""
+    return Config(
+        model=_gpt2_model(),
+        data=DataConfig(batch_size=8, seq_len=1024),
+        train=TrainConfig(num_steps=1000),
+    )
+
+
+@register_preset("llama3-8b-dp")
+def _p_llama8b_dp() -> Config:
+    """Baseline config 2: Llama-3 8B data-parallel (DDP -> XLA all-reduce)."""
+    return Config(
+        model=_llama3_8b_model(),
+        parallel=ParallelConfig(dp=64),
+        data=DataConfig(batch_size=64, seq_len=8192),
+        optimizer=OptimizerConfig(learning_rate=3e-4),
+    )
+
+
+@register_preset("llama3-70b-fsdp")
+def _p_llama70b_fsdp() -> Config:
+    """Baseline config 3: Llama-3 70B FSDP/ZeRO-3 sharded."""
+    return Config(
+        model=_llama3_70b_model(),
+        parallel=ParallelConfig(fsdp=64),
+        data=DataConfig(batch_size=64, seq_len=8192),
+        optimizer=OptimizerConfig(learning_rate=1.5e-4),
+    )
+
+
+@register_preset("mixtral-8x7b-ep")
+def _p_mixtral() -> Config:
+    """Baseline config 4: Mixtral 8x7B MoE, expert-parallel all-to-all."""
+    return Config(
+        model=_mixtral_model(),
+        parallel=ParallelConfig(fsdp=8, ep=8),
+        data=DataConfig(batch_size=64, seq_len=4096),
+    )
+
+
+@register_preset("llama3-8b-infer")
+def _p_llama8b_infer() -> Config:
+    """Baseline config 5: Llama-3 8B continuous-batching inference."""
+    return Config(
+        model=_llama3_8b_model(),
+        inference=InferenceConfig(max_seq_len=8192, num_pages=2048),
+    )
+
+
+# -- small variants for tests / the single-chip dev box ---------------------
+
+
+@register_preset("tiny")
+def _p_tiny() -> Config:
+    """Tiny GPT-2-family model for CPU tests."""
+    return Config(
+        model=_gpt2_model(name="tiny", vocab_size=256, max_seq_len=128,
+                          d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+                          d_ff=256),
+        data=DataConfig(batch_size=4, seq_len=64),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=5),
+        train=TrainConfig(num_steps=20, log_interval=5),
+        checkpoint=CheckpointConfig(save_interval_steps=10, max_to_keep=2),
+    )
+
+
+@register_preset("tiny-llama")
+def _p_tiny_llama() -> Config:
+    """Tiny Llama-family (RoPE/RMSNorm/SwiGLU/GQA) model for CPU tests."""
+    return Config(
+        model=_llama3_8b_model(name="tiny-llama", vocab_size=256,
+                               max_seq_len=128, d_model=64, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=128,
+                               dtype="float32", kernels="xla", remat="none"),
+        data=DataConfig(batch_size=4, seq_len=64),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=5),
+        train=TrainConfig(num_steps=20, log_interval=5),
+    )
+
+
+@register_preset("tiny-mixtral")
+def _p_tiny_mixtral() -> Config:
+    """Tiny Mixtral-family (MoE) model for CPU tests."""
+    return Config(
+        model=_mixtral_model(name="tiny-mixtral", vocab_size=256,
+                             max_seq_len=128, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128, n_experts=4,
+                             n_experts_per_token=2, dtype="float32",
+                             kernels="xla", remat="none"),
+        data=DataConfig(batch_size=4, seq_len=64),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=5),
+        train=TrainConfig(num_steps=20, log_interval=5),
+    )
+
+
+@register_preset("llama-1b-bench")
+def _p_llama_bench() -> Config:
+    """Llama-shaped ~1B model sized for the single-chip v5e dev box bench."""
+    return Config(
+        model=_llama3_8b_model(name="llama-1b", vocab_size=32768,
+                               max_seq_len=2048, d_model=2048, n_layers=16,
+                               n_heads=16, n_kv_heads=8, d_ff=7168,
+                               remat="none"),
+        data=DataConfig(batch_size=8, seq_len=2048),
+        optimizer=OptimizerConfig(moment_dtype="float32"),
+        train=TrainConfig(num_steps=30, log_interval=5),
+    )

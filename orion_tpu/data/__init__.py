@@ -1,0 +1,12 @@
+"""Data pipeline: per-host sharded LM batches.
+
+Reference equivalent: the tokenized data loading a ``train.py`` needs
+(SURVEY.md §3 "data pipeline"). TPU-native design decision: a loader is a
+*pure function of the step number* — ``batch_at(step)`` — so the data-iterator
+state that the reference checkpoints alongside model state collapses to the
+step counter already in the train state, making resume exact by construction.
+"""
+
+from orion_tpu.data.loader import Loader, make_loader
+
+__all__ = ["Loader", "make_loader"]

@@ -1,0 +1,140 @@
+"""Stateless-resume LM data loaders (synthetic + memmapped token shards).
+
+``batch_at(step)`` returns this host's shard of the global batch as numpy
+arrays; the trainer assembles a global device array via
+``jax.make_array_from_process_local_data``. Every loader is deterministic in
+(seed, step, process), so checkpoint/resume needs no iterator state.
+
+The memmap path reads flat token files (uint16/uint32); a native C++ reader
+with readahead lives in orion_tpu/data/native (used when available and
+``DataConfig.use_native_loader``), with a numpy fallback.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Mapping
+
+import jax
+import numpy as np
+
+from orion_tpu.config import DataConfig
+
+Batch = Mapping[str, np.ndarray]
+
+
+class Loader(abc.ABC):
+    """Per-host view of a deterministic global batch stream."""
+
+    def __init__(self, cfg: DataConfig, process_index: int, process_count: int):
+        if cfg.batch_size % process_count:
+            raise ValueError(
+                f"global batch {cfg.batch_size} not divisible by "
+                f"{process_count} processes"
+            )
+        self.cfg = cfg
+        self.process_index = process_index
+        self.process_count = process_count
+        self.host_batch = cfg.batch_size // process_count
+
+    @abc.abstractmethod
+    def batch_at(self, step: int) -> Batch:
+        """Host-local shard: inputs/targets [host_batch, seq_len] int32."""
+
+
+class SyntheticLoader(Loader):
+    """Deterministic pseudo-random tokens with a learnable structure.
+
+    Tokens follow a noisy modular progression so that a real model can drive
+    the loss well below log(vocab) — giving integration tests a 'loss goes
+    down' signal (SURVEY.md §5) without any dataset on disk.
+    """
+
+    def __init__(self, cfg: DataConfig, process_index: int, process_count: int,
+                 vocab_size: int):
+        super().__init__(cfg, process_index, process_count)
+        self.vocab_size = vocab_size
+
+    def batch_at(self, step: int) -> Batch:
+        b, s = self.host_batch, self.cfg.seq_len
+        rng = np.random.default_rng(
+            (self.cfg.shuffle_seed, step, self.process_index)
+        )
+        start = rng.integers(0, self.vocab_size, size=(b, 1))
+        ramp = np.arange(s + 1, dtype=np.int64)[None, :]
+        noise = rng.integers(0, 2, size=(b, s + 1))
+        seq = (start + 3 * ramp + noise) % self.vocab_size
+        seq = seq.astype(np.int32)
+        return {"inputs": seq[:, :-1], "targets": seq[:, 1:]}
+
+
+class MemmapLoader(Loader):
+    """Flat binary token file; samples length-(S+1) windows deterministically.
+
+    Window offsets are a pseudo-random but step-indexed permutation, so every
+    (seed, step) pair maps to a fixed set of windows across restarts.
+    """
+
+    def __init__(self, cfg: DataConfig, process_index: int, process_count: int,
+                 vocab_size: int):
+        super().__init__(cfg, process_index, process_count)
+        if cfg.path is None:
+            raise ValueError("memmap loader needs data.path")
+        self.reader = _open_reader(cfg)
+        self.n_tokens = len(self.reader)
+        need = cfg.seq_len + 1
+        if self.n_tokens < need * cfg.batch_size:
+            raise ValueError(
+                f"token file too small: {self.n_tokens} tokens for "
+                f"batch {cfg.batch_size} x seq {cfg.seq_len}"
+            )
+        self.n_windows = self.n_tokens - need + 1
+
+    def batch_at(self, step: int) -> Batch:
+        b, s = self.host_batch, self.cfg.seq_len
+        rng = np.random.default_rng(
+            (self.cfg.shuffle_seed, step, self.process_index)
+        )
+        offs = rng.integers(0, self.n_windows, size=b)
+        rows = self.reader.gather(offs, s + 1)
+        rows = rows.astype(np.int32)
+        return {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
+
+
+class _NumpyReader:
+    def __init__(self, path: str, dtype: np.dtype):
+        self.mm = np.memmap(path, dtype=dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.mm)
+
+    def gather(self, offsets: np.ndarray, width: int) -> np.ndarray:
+        return np.stack([np.asarray(self.mm[o : o + width]) for o in offsets])
+
+
+def _token_dtype(path: str) -> np.dtype:
+    # .u16/.u32 suffix convention; default uint16 (vocab < 65536).
+    if path.endswith(".u32") or path.endswith(".bin32"):
+        return np.dtype(np.uint32)
+    return np.dtype(np.uint16)
+
+
+def _open_reader(cfg: DataConfig):
+    dtype = _token_dtype(cfg.path)
+    if cfg.use_native_loader:
+        try:
+            from orion_tpu.data.native import NativeReader
+
+            return NativeReader(cfg.path, dtype)
+        except (ImportError, OSError):
+            pass
+    return _NumpyReader(cfg.path, dtype)
+
+
+def make_loader(cfg: DataConfig, vocab_size: int) -> Loader:
+    pi, pc = jax.process_index(), jax.process_count()
+    if cfg.source == "synthetic":
+        return SyntheticLoader(cfg, pi, pc, vocab_size)
+    if cfg.source == "memmap":
+        return MemmapLoader(cfg, pi, pc, vocab_size)
+    raise ValueError(f"unknown data source {cfg.source!r}")

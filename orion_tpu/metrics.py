@@ -1,0 +1,153 @@
+"""Step metrics, throughput and MFU accounting, structured logging.
+
+The judged metric is tokens/sec/chip + MFU for Llama-3-8B (BASELINE.json:2);
+this module owns that math (SURVEY.md §6 "Metrics / logging"): MFU = achieved
+model FLOP/s ÷ (chips × peak bf16 FLOP/s), with model FLOPs from the
+6·N·tokens estimate plus the attention term (ModelConfig.flops_per_token).
+Sinks: console, JSONL, and in-memory history for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+
+# Peak bf16 FLOP/s per chip by device kind; used for MFU. The dev chip is a
+# v5e (197 TF), the judged target a v5p (459 TF) — keep both so MFU is right
+# on either (SURVEY.md §8).
+PEAK_FLOPS_BF16: dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal, keeps MFU finite in CPU tests
+}
+
+
+def peak_flops_per_device(device: Optional[jax.Device] = None) -> float:
+    d = device if device is not None else jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS_BF16.items():
+        if key.lower() in kind.lower():
+            return val
+    return PEAK_FLOPS_BF16.get(kind, 1e12)
+
+
+@dataclass
+class StepMetrics:
+    step: int
+    loss: float
+    grad_norm: float = 0.0
+    learning_rate: float = 0.0
+    step_time_s: float = 0.0
+    tokens: int = 0
+    tokens_per_sec: float = 0.0
+    tokens_per_sec_per_device: float = 0.0
+    model_flops: float = 0.0
+    mfu: float = 0.0
+    extras: dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "step": self.step,
+            "loss": self.loss,
+            "grad_norm": self.grad_norm,
+            "lr": self.learning_rate,
+            "step_time_s": self.step_time_s,
+            "tokens": self.tokens,
+            "tokens_per_sec": self.tokens_per_sec,
+            "tokens_per_sec_per_device": self.tokens_per_sec_per_device,
+            "mfu": self.mfu,
+        }
+        d.update(self.extras)
+        return d
+
+
+class MetricsLogger:
+    """Accumulates per-step metrics; writes console lines and optional JSONL."""
+
+    def __init__(
+        self,
+        flops_per_token: float,
+        num_devices: int,
+        peak_flops: Optional[float] = None,
+        jsonl_path: Optional[str] = None,
+        log_interval: int = 10,
+    ):
+        self.flops_per_token = flops_per_token
+        self.num_devices = max(num_devices, 1)
+        self.peak_flops = peak_flops if peak_flops else peak_flops_per_device()
+        self.jsonl_path = jsonl_path
+        self.log_interval = max(log_interval, 1)
+        self.history: list[StepMetrics] = []
+        self._jsonl_file = None
+        if jsonl_path:
+            self._jsonl_file = open(jsonl_path, "a")
+
+    def record(
+        self,
+        step: int,
+        loss: float,
+        tokens: int,
+        step_time_s: float,
+        grad_norm: float = 0.0,
+        learning_rate: float = 0.0,
+        **extras: float,
+    ) -> StepMetrics:
+        tps = tokens / step_time_s if step_time_s > 0 else 0.0
+        model_flops = self.flops_per_token * tokens
+        achieved = model_flops / step_time_s if step_time_s > 0 else 0.0
+        mfu = achieved / (self.num_devices * self.peak_flops)
+        m = StepMetrics(
+            step=step,
+            loss=float(loss),
+            grad_norm=float(grad_norm),
+            learning_rate=float(learning_rate),
+            step_time_s=step_time_s,
+            tokens=tokens,
+            tokens_per_sec=tps,
+            tokens_per_sec_per_device=tps / self.num_devices,
+            model_flops=model_flops,
+            mfu=mfu,
+            extras=dict(extras),
+        )
+        self.history.append(m)
+        if self._jsonl_file is not None:
+            self._jsonl_file.write(json.dumps(m.to_dict()) + "\n")
+            self._jsonl_file.flush()
+        if step % self.log_interval == 0:
+            print(
+                f"step {step:>6d}  loss {m.loss:8.4f}  "
+                f"gnorm {m.grad_norm:7.3f}  lr {m.learning_rate:.2e}  "
+                f"{m.step_time_s * 1e3:7.1f} ms/step  "
+                f"{m.tokens_per_sec_per_device:9.0f} tok/s/dev  "
+                f"MFU {m.mfu * 100:5.2f}%"
+            )
+        return m
+
+    def close(self) -> None:
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+            self._jsonl_file = None
+
+
+class Stopwatch:
+    """Wall-clock timer for step timing (blocks on device completion)."""
+
+    def __init__(self):
+        self._t = time.perf_counter()
+
+    def lap(self, sync_on: Any = None) -> float:
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        now = time.perf_counter()
+        dt = now - self._t
+        self._t = now
+        return dt

@@ -1,0 +1,15 @@
+"""Model zoo: one generic decoder-only transformer, configured per family.
+
+Reference workloads (BASELINE.json:6-12): GPT-2 125M, Llama-3 8B/70B,
+Mixtral 8x7B — all instances of ``orion_tpu.models.transformer`` selected via
+``ModelConfig`` (see the presets in orion_tpu.config).
+"""
+
+from orion_tpu.models.transformer import (
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+
+__all__ = ["forward", "init_params", "loss_fn", "param_logical_axes"]

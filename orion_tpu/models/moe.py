@@ -1,0 +1,94 @@
+"""Mixture-of-experts layer with capacity-based top-k dispatch.
+
+Covers the reference's Mixtral 8x7B workload (BASELINE.json:10, "expert-
+parallel all-to-all"). TPU-native design: dispatch/combine are einsums against
+a static-capacity one-hot tensor, so everything is static-shaped for XLA, and
+expert parallelism is purely a sharding choice — the expert axis of the
+weights is sharded on the ``ep`` mesh axis and XLA inserts the all-to-all
+(ICI) at the dispatch/combine boundaries. Overflowing tokens beyond capacity
+are dropped (Switch-style), which keeps the hot path dense.
+
+Aux load-balancing loss follows Switch/Mixtral: E * sum_e f_e * p_e.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+
+
+def moe_capacity(cfg: ModelConfig, tokens_per_group: int) -> int:
+    cap = int(cfg.capacity_factor * tokens_per_group * cfg.n_experts_per_token
+              / cfg.n_experts)
+    return max(cap, 1)
+
+
+def route(
+    x: jax.Array, router_w: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Router: returns (dispatch [B,S,E,C], combine [B,S,E,C], aux_loss)."""
+    B, S, _ = x.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+    C = moe_capacity(cfg, S)
+
+    logits = jnp.einsum(
+        "bsd,de->bse", x, router_w, preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B,S,E] f32
+
+    gate, idx = jax.lax.top_k(probs, k)  # [B,S,k]
+    gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)  # renormalize
+
+    # Slot-major priority: all slot-0 (top-1) choices claim capacity before
+    # any slot-1 choice, matching Switch-Transformer semantics.
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B,S,k,E]
+    prio = onehot.transpose(0, 2, 1, 3).reshape(B, k * S, E)  # [B,k*S,E]
+    pos = jnp.cumsum(prio, axis=1) - prio  # position within expert
+    keep = (pos < C).astype(jnp.float32) * prio
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    disp_flat = keep[..., None] * pos_oh  # [B,k*S,E,C]
+    disp = disp_flat.reshape(B, k, S, E, C).sum(axis=1)  # [B,S,E,C]
+
+    gate_slot = gate.transpose(0, 2, 1).reshape(B, k, S)[..., None, None]
+    comb = (
+        disp_flat.reshape(B, k, S, E, C) * gate_slot
+    ).sum(axis=1)  # [B,S,E,C]
+
+    # Load-balance aux loss (Switch eq. 4): E * sum_e fraction_e * prob_e.
+    frac = onehot[:, :, 0, :].mean(axis=(0, 1)) if k == 1 else (
+        onehot.sum(axis=2).mean(axis=(0, 1)) / k
+    )
+    mean_prob = probs.mean(axis=(0, 1))
+    aux = E * jnp.sum(frac * mean_prob)
+    return disp, comb, aux
+
+
+def moe_mlp(
+    x: jax.Array, params: dict[str, Any], cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """MoE feed-forward. x: [B,S,D] -> ([B,S,D], aux_loss).
+
+    params: router [D,E]; w_in, w_gate [E,D,F]; w_out [E,F,D].
+    Expert-parallel: shard the leading E axis of w_* (and the E axis of the
+    einsum operands) on the ``ep`` mesh axis.
+    """
+    dtype = x.dtype
+    disp, comb, aux = route(x, params["router"], cfg)
+    disp = disp.astype(dtype)
+    comb = comb.astype(dtype)
+
+    # Dispatch: [B,S,E,C] x [B,S,D] -> [E, B*C? ] keep (E,B,C,D) grouping.
+    xin = jnp.einsum("bsec,bsd->ebcd", disp, x)
+    h_in = jnp.einsum("ebcd,edf->ebcf", xin, params["w_in"])
+    if cfg.activation == "swiglu":
+        h_gate = jnp.einsum("ebcd,edf->ebcf", xin, params["w_gate"])
+        h = jax.nn.silu(h_gate) * h_in
+    else:
+        h = jax.nn.gelu(h_in)
+    out = jnp.einsum("ebcf,efd->ebcd", h, params["w_out"])
+    y = jnp.einsum("bsec,ebcd->bsd", comb, out)
+    return y, aux.astype(jnp.float32)

@@ -1,0 +1,340 @@
+"""The decoder-only transformer: one parameterization for the whole zoo.
+
+Reference model families (SURVEY.md §3 "models"): GPT-2 (learned positions,
+LayerNorm, GELU, tied embeddings), Llama-3 (RoPE, RMSNorm, SwiGLU, GQA) and
+Mixtral (Llama + top-k MoE) — all expressed by ``ModelConfig`` switches over
+this single implementation, the idiomatic TPU shape: pure-pytree params, a
+``lax.scan`` over stacked per-layer weights (fast compiles, layer-count
+independent HLO), optional ``jax.checkpoint`` rematerialization, and a
+logical-axis tree per parameter that ``orion_tpu.parallel.sharding`` maps to
+mesh axes (dp/fsdp/tp/sp/ep) — parallelism never appears in model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu import ops
+from orion_tpu.config import ModelConfig
+from orion_tpu.models import moe as moe_lib
+
+Params = dict[str, Any]
+
+# ---------------------------------------------------------------------------
+# Initialization (+ the logical-axis tree used by parallel.sharding)
+# ---------------------------------------------------------------------------
+
+
+def _normal(key, shape, dtype, std: float):
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Initialize the parameter pytree.
+
+    GPT-2-style scheme: N(0, 0.02) everywhere, residual output projections
+    scaled by 1/sqrt(2L). Stored in ``cfg.param_dtype`` (fp32 master copy).
+    """
+    pdt = jnp.dtype(cfg.param_dtype)
+    D, V, L = cfg.d_model, cfg.vocab_size, cfg.n_layers
+    H = cfg.resolved_head_dim
+    N, K, F = cfg.n_heads, cfg.n_kv_heads, cfg.d_ff
+    std = 0.02
+    resid_std = std / (2 * L) ** 0.5
+
+    keys = iter(jax.random.split(key, 64))
+
+    params: Params = {
+        "embed": {"tokens": _normal(next(keys), (V, D), pdt, std)},
+        "final_norm": {"scale": jnp.ones((D,), pdt)},
+    }
+    if cfg.pos_embedding == "learned":
+        params["embed"]["positions"] = _normal(
+            next(keys), (cfg.max_seq_len, D), pdt, std
+        )
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _normal(next(keys), (D, V), pdt, std)
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((D,), pdt)
+
+    def init_block(bkey: jax.Array) -> Params:
+        bkeys = iter(jax.random.split(bkey, 16))
+        block: Params = {
+            "attn_norm": {"scale": jnp.ones((D,), pdt)},
+            "mlp_norm": {"scale": jnp.ones((D,), pdt)},
+            "attn": {
+                "wq": _normal(next(bkeys), (D, N * H), pdt, std),
+                "wk": _normal(next(bkeys), (D, K * H), pdt, std),
+                "wv": _normal(next(bkeys), (D, K * H), pdt, std),
+                "wo": _normal(next(bkeys), (N * H, D), pdt, resid_std),
+            },
+        }
+        if cfg.norm == "layernorm":
+            block["attn_norm"]["bias"] = jnp.zeros((D,), pdt)
+            block["mlp_norm"]["bias"] = jnp.zeros((D,), pdt)
+        if cfg.attn_bias:
+            block["attn"]["bq"] = jnp.zeros((N * H,), pdt)
+            block["attn"]["bk"] = jnp.zeros((K * H,), pdt)
+            block["attn"]["bv"] = jnp.zeros((K * H,), pdt)
+            block["attn"]["bo"] = jnp.zeros((D,), pdt)
+        if cfg.is_moe:
+            E = cfg.n_experts
+            block["moe"] = {
+                "router": _normal(next(bkeys), (D, E), pdt, std),
+                "w_in": _normal(next(bkeys), (E, D, F), pdt, std),
+                "w_out": _normal(next(bkeys), (E, F, D), pdt, resid_std),
+            }
+            if cfg.activation == "swiglu":
+                block["moe"]["w_gate"] = _normal(next(bkeys), (E, D, F), pdt, std)
+        else:
+            block["mlp"] = {
+                "w_in": _normal(next(bkeys), (D, F), pdt, std),
+                "w_out": _normal(next(bkeys), (F, D), pdt, resid_std),
+            }
+            if cfg.activation == "swiglu":
+                block["mlp"]["w_gate"] = _normal(next(bkeys), (D, F), pdt, std)
+            if cfg.mlp_bias:
+                block["mlp"]["b_in"] = jnp.zeros((F,), pdt)
+                block["mlp"]["b_out"] = jnp.zeros((D,), pdt)
+
+        return block
+
+    layer_keys = jax.random.split(next(keys), L)
+    if cfg.scan_layers:
+        params["blocks"] = jax.vmap(init_block)(layer_keys)
+    else:
+        params["blocks"] = [init_block(k) for k in layer_keys]
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree matching init_params' structure; leaves are logical-axis tuples.
+
+    Logical names are mapped to mesh axes by parallel.sharding rules:
+    vocab/heads/mlp -> tp, embed -> fsdp, expert -> ep, layers -> unsharded.
+    """
+    lead = ("layers",) if cfg.scan_layers else ()
+
+    block = {
+        "attn_norm": {"scale": lead + ("embed",)},
+        "mlp_norm": {"scale": lead + ("embed",)},
+        "attn": {
+            "wq": lead + ("embed", "heads"),
+            "wk": lead + ("embed", "kv_heads"),
+            "wv": lead + ("embed", "kv_heads"),
+            "wo": lead + ("heads", "embed"),
+        },
+    }
+    if cfg.norm == "layernorm":
+        block["attn_norm"]["bias"] = lead + ("embed",)
+        block["mlp_norm"]["bias"] = lead + ("embed",)
+    if cfg.attn_bias:
+        block["attn"]["bq"] = lead + ("heads",)
+        block["attn"]["bk"] = lead + ("kv_heads",)
+        block["attn"]["bv"] = lead + ("kv_heads",)
+        block["attn"]["bo"] = lead + ("embed",)
+    if cfg.is_moe:
+        block["moe"] = {
+            "router": lead + ("embed", "expert"),
+            "w_in": lead + ("expert", "embed", "mlp"),
+            "w_out": lead + ("expert", "mlp", "embed"),
+        }
+        if cfg.activation == "swiglu":
+            block["moe"]["w_gate"] = lead + ("expert", "embed", "mlp")
+    else:
+        block["mlp"] = {
+            "w_in": lead + ("embed", "mlp"),
+            "w_out": lead + ("mlp", "embed"),
+        }
+        if cfg.activation == "swiglu":
+            block["mlp"]["w_gate"] = lead + ("embed", "mlp")
+        if cfg.mlp_bias:
+            block["mlp"]["b_in"] = lead + ("mlp",)
+            block["mlp"]["b_out"] = lead + ("embed",)
+
+    axes: Params = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "final_norm": {"scale": ("embed",)},
+        "blocks": block if cfg.scan_layers else [block] * cfg.n_layers,
+    }
+    if cfg.pos_embedding == "learned":
+        axes["embed"]["positions"] = ("pos", "embed")
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    if cfg.norm == "layernorm":
+        axes["final_norm"]["bias"] = ("embed",)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _norm(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    if cfg.norm == "rmsnorm":
+        return ops.rmsnorm(x, p["scale"], eps=cfg.norm_eps, impl=cfg.kernels)
+    return ops.layernorm(x, p["scale"], p.get("bias"), eps=cfg.norm_eps)
+
+
+def _attn_block(
+    x: jax.Array,
+    p: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+) -> jax.Array:
+    B, S, _ = x.shape
+    N, K, H = cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim
+    dtype = x.dtype
+
+    q = jnp.einsum("bsd,dh->bsh", x, p["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dh->bsh", x, p["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dh->bsh", x, p["wv"].astype(dtype))
+    if cfg.attn_bias:
+        q = q + p["bq"].astype(dtype)
+        k = k + p["bk"].astype(dtype)
+        v = v + p["bv"].astype(dtype)
+    q = q.reshape(B, S, N, H)
+    k = k.reshape(B, S, K, H)
+    v = v.reshape(B, S, K, H)
+
+    if cfg.pos_embedding == "rope":
+        q = ops.apply_rope(q, positions, theta=cfg.rope_theta, impl=cfg.kernels)
+        k = ops.apply_rope(k, positions, theta=cfg.rope_theta, impl=cfg.kernels)
+
+    out = ops.attention(
+        q,
+        k,
+        v,
+        causal=True,
+        q_segment_ids=segment_ids,
+        kv_segment_ids=segment_ids,
+        logit_softcap=cfg.attn_logit_softcap,
+        impl=cfg.kernels,
+    )
+    out = out.reshape(B, S, N * H)
+    y = jnp.einsum("bsh,hd->bsd", out, p["wo"].astype(dtype))
+    if cfg.attn_bias:
+        y = y + p["bo"].astype(dtype)
+    return y
+
+
+def _mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    dtype = x.dtype
+    h_in = jnp.einsum("bsd,df->bsf", x, p["w_in"].astype(dtype))
+    if cfg.mlp_bias:
+        h_in = h_in + p["b_in"].astype(dtype)
+    if cfg.activation == "swiglu":
+        h_gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(dtype))
+        h = jax.nn.silu(h_gate) * h_in
+    else:
+        h = jax.nn.gelu(h_in)
+    y = jnp.einsum("bsf,fd->bsd", h, p["w_out"].astype(dtype))
+    if cfg.mlp_bias:
+        y = y + p["b_out"].astype(dtype)
+    return y
+
+
+def _block(
+    x: jax.Array,
+    bp: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+) -> tuple[jax.Array, jax.Array]:
+    """One transformer block. Returns (x, moe_aux_loss)."""
+    x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
+                        positions, segment_ids)
+    h = _norm(x, bp["mlp_norm"], cfg)
+    if cfg.is_moe:
+        moe_params = {
+            k: v.astype(x.dtype) if k != "router" else v
+            for k, v in bp["moe"].items()
+        }
+        y, aux = moe_lib.moe_mlp(h, moe_params, cfg)
+    else:
+        y = _mlp_block(h, bp["mlp"], cfg)
+        aux = jnp.zeros((), jnp.float32)
+    return x + y, aux
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] int32 -> (logits [B, S, V] float32, moe_aux scalar)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"]["tokens"].astype(dtype)[tokens]
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["positions"].astype(dtype)[positions]
+
+    def block_fn(carry, bp):
+        y, aux = _block(carry, bp, cfg, positions, segment_ids)
+        return y, aux
+
+    if cfg.remat == "full":
+        block_fn = jax.checkpoint(block_fn)
+    elif cfg.remat == "dots":
+        block_fn = jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+
+    if cfg.scan_layers:
+        x, aux = jax.lax.scan(block_fn, x, params["blocks"])
+        moe_aux = aux.sum()
+    else:
+        moe_aux = jnp.zeros((), jnp.float32)
+        for bp in params["blocks"]:
+            x, aux = block_fn(x, bp)
+            moe_aux = moe_aux + aux
+
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"]["tokens"].astype(dtype)
+        )
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32), moe_aux
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    cfg: ModelConfig,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Next-token cross-entropy + weighted MoE aux loss.
+
+    batch: inputs [B,S], targets [B,S], optional loss_mask [B,S] (1 = count),
+    optional segment_ids/positions for packed sequences.
+    """
+    logits, moe_aux = forward(
+        params,
+        batch["inputs"],
+        cfg,
+        positions=batch.get("positions"),
+        segment_ids=batch.get("segment_ids"),
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    ce = (nll * mask).sum() / denom
+    loss = ce + cfg.router_aux_loss_weight * moe_aux
+    return loss, {"ce_loss": ce, "moe_aux": moe_aux, "tokens": denom}

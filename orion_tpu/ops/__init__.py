@@ -1,0 +1,25 @@
+"""Fused-op surface: attention, RoPE, RMSNorm/LayerNorm.
+
+TPU-native replacement for the reference's ``orion.ops`` CUDA kernels
+(BASELINE.json:5 — "fused attention/RoPE/RMSNorm CUDA kernels ... become
+Pallas"). Every op has two implementations behind one interface:
+
+  - ``xla``    — pure jnp; XLA fuses the elementwise work. The reference
+                 semantics, the CPU/test path, and the fallback.
+  - ``pallas`` — hand-written TPU kernels (orion_tpu.ops.pallas.*) for the
+                 hot ops where manual fusion/blocking beats XLA.
+
+Selection is by ``ModelConfig.kernels`` or per-call ``impl=``.
+"""
+
+from orion_tpu.ops.norms import layernorm, rmsnorm
+from orion_tpu.ops.rope import apply_rope, rope_frequencies
+from orion_tpu.ops.attention import attention
+
+__all__ = [
+    "attention",
+    "apply_rope",
+    "layernorm",
+    "rmsnorm",
+    "rope_frequencies",
+]

@@ -1,0 +1,132 @@
+"""Multi-head attention (reference ``orion.ops`` fused-attention equivalent).
+
+The xla implementation is the semantic reference: grouped-query causal
+attention with a numerically stable float32 softmax, optional segment masking
+(packed sequences) and logit soft-capping. The Pallas flash kernel
+(orion_tpu.ops.pallas.flash_attention) implements the same contract with
+blockwise online softmax; both are exercised against each other in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _gqa_expand(k: jax.Array, n_heads: int) -> jax.Array:
+    """[B, S, K, H] -> [B, S, N, H] by repeating each kv head N/K times."""
+    n_kv = k.shape[2]
+    if n_kv == n_heads:
+        return k
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
+    return jnp.repeat(k, n_heads // n_kv, axis=2)
+
+
+def attention_mask(
+    q_len: int,
+    kv_len: int,
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+) -> Optional[jax.Array]:
+    """Boolean [.., q_len, kv_len] mask; True = attend."""
+    mask = None
+    if causal:
+        q_pos = jnp.arange(q_len) + q_offset
+        kv_pos = jnp.arange(kv_len)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+    if q_segment_ids is not None:
+        seg = q_segment_ids[..., :, None] == kv_segment_ids[..., None, :]
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """q: [B, Sq, N, H]; k, v: [B, Skv, K, H] with N % K == 0 -> [B, Sq, N, H]."""
+    dtype = q.dtype
+    n_heads, head_dim = q.shape[2], q.shape[3]
+    k = _gqa_expand(k, n_heads)
+    v = _gqa_expand(v, n_heads)
+
+    scale = head_dim ** -0.5
+    logits = jnp.einsum(
+        "bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+
+    if mask is None:
+        mask = attention_mask(
+            q.shape[1],
+            k.shape[1],
+            causal=causal,
+            q_offset=q_offset,
+            q_segment_ids=q_segment_ids,
+            kv_segment_ids=kv_segment_ids,
+        )
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None, :, :]
+        elif mask.ndim == 3:  # [B, q, kv]
+            mask = mask[:, None, :, :]
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+    q_offset: int = 0,
+    impl: str = "xla",
+) -> jax.Array:
+    """Grouped-query scaled-dot-product attention. Shapes as attention_xla."""
+    if impl == "pallas":
+        from orion_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            q_segment_ids=q_segment_ids,
+            kv_segment_ids=kv_segment_ids,
+            logit_softcap=logit_softcap,
+            q_offset=q_offset,
+        )
+    return attention_xla(
+        q,
+        k,
+        v,
+        causal=causal,
+        mask=mask,
+        q_segment_ids=q_segment_ids,
+        kv_segment_ids=kv_segment_ids,
+        logit_softcap=logit_softcap,
+        q_offset=q_offset,
+    )

@@ -1,0 +1,27 @@
+"""Parallelism strategies as sharding rules over the named mesh.
+
+TPU-native replacement for the reference's ``orion.parallel`` wrapper modules
+(``orion.parallel.ddp``, ``orion.parallel.fsdp``; BASELINE.json:8-9) and the
+brief's TP/PP/SP/CP/EP/ring/Ulysses strategies: instead of per-strategy
+wrapper classes, each strategy is a set of entries in one logical-axis ->
+mesh-axis rule table (SURVEY.md §8 "hard parts" #3). DDP = batch on dp;
+ZeRO-3 = params' embed axis on fsdp (XLA gathers on use); TP = heads/mlp/vocab
+on tp; EP = expert axis on ep; SP/ring/Ulysses = sequence on sp (see
+orion_tpu.parallel.ring / ulysses); PP = layer stages on pp (parallel.pipeline).
+"""
+
+from orion_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_sharding,
+    logical_to_spec,
+    param_shardings,
+    shard_init,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "batch_sharding",
+    "logical_to_spec",
+    "param_shardings",
+    "shard_init",
+]

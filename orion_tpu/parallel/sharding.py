@@ -1,0 +1,117 @@
+"""Logical-axis -> mesh-axis sharding rules.
+
+One rule table keyed by logical axis names (the torchtitan/MaxText-style
+solution to composable dp×fsdp×tp×sp×ep sharding, SURVEY.md §8) replaces the
+reference's per-strategy wrapper code paths. Model code annotates parameters
+with logical names (models.transformer.param_logical_axes); this module turns
+them into ``NamedSharding``s; jit + XLA turn those into collectives:
+
+  - grads psum over dp          == DDP all-reduce       (BASELINE.json:8)
+  - param gather-on-use on fsdp == FSDP/ZeRO-3          (BASELINE.json:9)
+  - heads/mlp matmul split on tp == megatron-style TP
+  - expert dispatch on ep       == MoE all-to-all        (BASELINE.json:10)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, tuple[str, ...]]
+
+# Logical axis name -> mesh axis (or axes). None = replicated along that dim.
+DEFAULT_RULES: dict[str, MeshAxes] = {
+    # Activations.
+    "batch": ("dp", "fsdp"),   # fsdp shards the batch too (ZeRO data-parallel)
+    "seq": "sp",
+    # Parameters.
+    "embed": "fsdp",           # ZeRO-3: gather-on-use along the embed axis
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": None,            # scan axis; pipeline stages shard this on pp
+    "pos": None,
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str],
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    If ``mesh`` is given, mesh axes of size 1 are elided (cosmetic: P(None)
+    instead of P('tp') when tp=1) and duplicate mesh-axis use across dims
+    raises (a logical tree bug).
+    """
+    spec: list[MeshAxes] = []
+    used: set[str] = set()
+    for name in logical_axes:
+        if name not in rules:
+            raise ValueError(f"no sharding rule for logical axis {name!r}")
+        target = rules[name]
+        if target is None:
+            spec.append(None)
+            continue
+        axes = (target,) if isinstance(target, str) else tuple(target)
+        if mesh is not None:
+            axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        live = []
+        for a in axes:
+            if a in used:
+                raise ValueError(
+                    f"mesh axis {a!r} used twice in logical axes {logical_axes}"
+                )
+            used.add(a)
+            live.append(a)
+        if not live:
+            spec.append(None)
+        elif len(live) == 1:
+            spec.append(live[0])
+        else:
+            spec.append(tuple(live))
+    return P(*spec)
+
+
+def param_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+) -> Any:
+    """Pytree of NamedShardings matching a logical-axes pytree."""
+    def leaf(axes):
+        return NamedSharding(mesh, logical_to_spec(axes, rules, mesh))
+
+    return jax.tree.map(
+        leaf, logical_tree, is_leaf=lambda x: isinstance(x, tuple)
+    )
+
+
+def batch_sharding(
+    mesh: Mesh,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+    *,
+    shard_seq: bool = True,
+) -> NamedSharding:
+    """Sharding for [B, S] token batches (and [B, S] masks/positions)."""
+    seq = "seq" if shard_seq else "pos"
+    spec = logical_to_spec(("batch", seq), {**rules, "pos": None}, mesh)
+    return NamedSharding(mesh, spec)
+
+
+def shard_init(
+    init_fn: Callable[[], Any],
+    shardings: Any,
+) -> Any:
+    """Run an initializer with outputs materialized directly into shardings.
+
+    jit with out_shardings means each device only ever materializes its own
+    shard — required to init 70B-class models without host OOM
+    (SURVEY.md §4 stack A, model.build).
+    """
+    return jax.jit(init_fn, out_shardings=shardings)()

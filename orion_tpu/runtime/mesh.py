@@ -1,0 +1,91 @@
+"""Device-mesh construction over ICI / DCN.
+
+The mesh is the TPU-native communicator: every parallelism strategy in
+``orion_tpu.parallel`` is a set of named axes here (SURVEY.md §2 layer L1/L2).
+Axis order is chosen for ICI locality — the innermost (fastest-varying) axes
+get physically adjacent devices, so the bandwidth-hungry axes (tp, then sp/ep)
+ride the shortest ICI hops, while pp/dp tolerate the outermost placement and
+any DCN split.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from orion_tpu.config import ParallelConfig
+
+log = logging.getLogger("orion_tpu.runtime")
+
+# Outermost -> innermost. tp innermost (highest-bandwidth collectives),
+# pp outermost (lowest-frequency p2p traffic).
+MESH_AXES: tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def mesh_devices(platform: Optional[str] = None) -> list[jax.Device]:
+    """All devices for mesh construction, honoring an explicit platform.
+
+    On the dev box a sitecustomize forces the axon TPU plugin as default
+    backend, so CPU fake devices must be selected explicitly via
+    ``jax.devices("cpu")`` (SURVEY.md §5 gotcha).
+    """
+    if platform is not None:
+        return list(jax.devices(platform))
+    return list(jax.devices())
+
+
+def build_mesh(
+    parallel: ParallelConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+    platform: Optional[str] = None,
+) -> Mesh:
+    """Build the named Mesh for a ParallelConfig.
+
+    Single-slice: devices are laid out with ``mesh_utils.create_device_mesh``
+    so ICI topology is respected. Multi-slice (``parallel.dcn_axes`` set):
+    hybrid mesh with the listed axes crossing DCN.
+    """
+    devs = list(devices) if devices is not None else mesh_devices(platform)
+    sizes = parallel.axis_sizes
+    n = parallel.num_devices
+    if n > len(devs):
+        raise ValueError(
+            f"parallel config wants {n} devices "
+            f"({dict(sizes)}), but only {len(devs)} are available"
+        )
+    if n < len(devs):
+        log.warning(
+            "parallel config uses %d of %d available devices", n, len(devs)
+        )
+        devs = devs[:n]
+    shape = tuple(sizes[a] for a in MESH_AXES)
+
+    if parallel.dcn_axes:
+        from jax.experimental import mesh_utils
+
+        ici_shape = tuple(1 if a in parallel.dcn_axes else sizes[a] for a in MESH_AXES)
+        dcn_shape = tuple(sizes[a] if a in parallel.dcn_axes else 1 for a in MESH_AXES)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devs
+        )
+        return Mesh(arr, MESH_AXES)
+
+    if devices is None and devs and devs[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=devs)
+    else:
+        # CPU fake devices / explicit device list: plain row-major reshape.
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def local_mesh(platform: Optional[str] = None) -> Mesh:
+    """Trivial all-ones mesh over however many devices exist locally (dp)."""
+    devs = mesh_devices(platform)
+    cfg = ParallelConfig(dp=len(devs))
+    return build_mesh(cfg, devices=devs)

@@ -1,0 +1,23 @@
+"""Training runtime (reference ``orion.trainer`` equivalent, BASELINE.json:5).
+
+The step loop, optimizer, LR schedule, grad accumulation/clipping — compiled
+into a single XLA program per step (SURVEY.md §4 stack A): no Python in the
+hot loop, donated buffers, collectives inserted by XLA from the sharding
+rules in orion_tpu.parallel.
+"""
+
+from orion_tpu.train.optimizer import (
+    init_opt_state,
+    make_schedule,
+    apply_updates,
+)
+from orion_tpu.train.trainer import Trainer, make_train_step, init_train_state
+
+__all__ = [
+    "Trainer",
+    "apply_updates",
+    "init_opt_state",
+    "init_train_state",
+    "make_schedule",
+    "make_train_step",
+]

@@ -1,0 +1,122 @@
+"""AdamW with explicit, shardable state.
+
+The optimizer state is a plain pytree ``{"mu": <like params>, "nu": <like
+params>, "count": scalar}`` rather than an opaque optax chain state, so the
+ZeRO-3 story is one line: moments inherit the parameters' NamedShardings
+(SURVEY.md §3 FSDP row — params+grads+opt state all sharded). Schedules come
+from optax (pure functions, no state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from orion_tpu.config import OptimizerConfig
+
+OptState = dict[str, Any]
+
+# Parameter leaves exempt from weight decay: norm scales and all biases.
+_NO_DECAY_KEYS = frozenset(
+    {"scale", "bias", "bq", "bk", "bv", "bo", "b_in", "b_out"}
+)
+
+
+def make_schedule(
+    cfg: OptimizerConfig, num_steps: int
+) -> Callable[[jax.Array], jax.Array]:
+    decay_steps = cfg.decay_steps if cfg.decay_steps is not None else num_steps
+    # Keep schedules well-formed when num_steps < warmup (smoke tests).
+    decay_steps = max(decay_steps, cfg.warmup_steps + 1)
+    peak, floor = cfg.learning_rate, cfg.learning_rate * cfg.min_lr_ratio
+    if cfg.schedule == "constant":
+        warm = optax.linear_schedule(0.0, peak, cfg.warmup_steps)
+        return optax.join_schedules(
+            [warm, optax.constant_schedule(peak)], [cfg.warmup_steps]
+        )
+    if cfg.schedule == "linear":
+        warm = optax.linear_schedule(0.0, peak, cfg.warmup_steps)
+        decay = optax.linear_schedule(
+            peak, floor, max(decay_steps - cfg.warmup_steps, 1)
+        )
+        return optax.join_schedules([warm, decay], [cfg.warmup_steps])
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=decay_steps,
+        end_value=floor,
+    )
+
+
+def init_opt_state(params: Any, cfg: OptimizerConfig) -> OptState:
+    mdt = jnp.dtype(cfg.moment_dtype)
+
+    def zeros(p):
+        return jnp.zeros(p.shape, mdt)
+
+    return {
+        "mu": jax.tree.map(zeros, params),
+        "nu": jax.tree.map(zeros, params),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def _decay_mask(path) -> bool:
+    last = path[-1]
+    key = getattr(last, "key", None)
+    return key not in _NO_DECAY_KEYS
+
+
+def global_norm(tree: Any) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def apply_updates(
+    params: Any,
+    grads: Any,
+    opt_state: OptState,
+    cfg: OptimizerConfig,
+    learning_rate: jax.Array,
+) -> tuple[Any, OptState, dict[str, jax.Array]]:
+    """One AdamW update. Returns (params, opt_state, metrics)."""
+    gnorm = global_norm(grads)
+    if cfg.grad_clip_norm > 0:
+        scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-9))
+    else:
+        scale = jnp.ones((), jnp.float32)
+
+    count = opt_state["count"] + 1
+    cf = count.astype(jnp.float32)
+    bc1 = 1.0 - cfg.b1 ** cf
+    bc2 = 1.0 - cfg.b2 ** cf
+    mdt = jnp.dtype(cfg.moment_dtype)
+
+    def upd(path, p, g, mu, nu):
+        g = g.astype(jnp.float32) * scale
+        mu_f = cfg.b1 * mu.astype(jnp.float32) + (1 - cfg.b1) * g
+        nu_f = cfg.b2 * nu.astype(jnp.float32) + (1 - cfg.b2) * jnp.square(g)
+        step = (mu_f / bc1) / (jnp.sqrt(nu_f / bc2) + cfg.eps)
+        if cfg.weight_decay > 0 and _decay_mask(path):
+            step = step + cfg.weight_decay * p.astype(jnp.float32)
+        new_p = p.astype(jnp.float32) - learning_rate * step
+        return new_p.astype(p.dtype), mu_f.astype(mdt), nu_f.astype(mdt)
+
+    flat = jax.tree_util.tree_map_with_path(
+        lambda path, p, g, mu, nu: upd(path, p, g, mu, nu),
+        params, grads, opt_state["mu"], opt_state["nu"],
+    )
+    # Unzip the 3-tuples back into three trees.
+    is_triple = lambda x: isinstance(x, tuple) and len(x) == 3 and not isinstance(x[0], tuple)
+    new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=is_triple)
+    new_mu = jax.tree.map(lambda t: t[1], flat, is_leaf=is_triple)
+    new_nu = jax.tree.map(lambda t: t[2], flat, is_leaf=is_triple)
+
+    new_state = {"mu": new_mu, "nu": new_nu, "count": count}
+    return new_params, new_state, {"grad_norm": gnorm}

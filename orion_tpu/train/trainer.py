@@ -1,0 +1,290 @@
+"""The training step loop (reference ``orion.trainer`` equivalent).
+
+Design (SURVEY.md §4 stack A): control crosses host->device once per step —
+batch feed in, metric scalars out. Everything else (forward, backward, grad
+accumulation, clipping, AdamW update, the DDP psum / ZeRO-3 gathers / TP and
+EP collectives implied by the sharding rules) is one jit-compiled XLA program
+with donated buffers. Fault injection and preemption-safe resume hook in at
+the step boundary (SURVEY.md §6 "Failure detection").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from orion_tpu import metrics as metrics_lib
+from orion_tpu.ckpt import CheckpointManager
+from orion_tpu.config import Config
+from orion_tpu.data import make_loader
+from orion_tpu.models import init_params, loss_fn, param_logical_axes
+from orion_tpu.parallel import batch_sharding, param_shardings
+from orion_tpu.runtime import build_mesh, initialize
+from orion_tpu.train.optimizer import (
+    apply_updates,
+    init_opt_state,
+    make_schedule,
+)
+
+log = logging.getLogger("orion_tpu.train")
+
+TrainState = dict[str, Any]
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the --inject_fault_at_step test hook (SURVEY.md §6)."""
+
+
+def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
+    params = init_params(cfg.model, key)
+    return {
+        "params": params,
+        "opt": init_opt_state(params, cfg.optimizer),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def state_shardings(cfg: Config, mesh) -> TrainState:
+    """NamedShardings for the full train state: ZeRO-3 by construction —
+    moments share the params' shardings, scalars are replicated."""
+    pshard = param_shardings(mesh, param_logical_axes(cfg.model))
+    repl = NamedSharding(mesh, P())
+    return {
+        "params": pshard,
+        "opt": {"mu": pshard, "nu": pshard, "count": repl},
+        "step": repl,
+    }
+
+
+def make_train_step(
+    cfg: Config, schedule: Callable[[jax.Array], jax.Array]
+) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    mcfg = cfg.model
+    accum = cfg.train.grad_accum
+
+    def loss_and_grads(params, batch):
+        if accum == 1:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, batch, mcfg)
+            return loss, aux, grads
+
+        # batch leaves are [A, b, S]; scan over microbatches, summing grads.
+        def micro(carry, mb):
+            acc_grads, acc_loss, acc_aux = carry
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, mb, mcfg)
+            acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+            acc_loss = acc_loss + loss
+            acc_aux = jax.tree.map(jnp.add, acc_aux, aux)
+            return (acc_grads, acc_loss, acc_aux), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        micro0 = jax.tree.map(lambda v: v[0], batch)
+        aux_shapes = jax.eval_shape(
+            lambda p, b: loss_fn(p, b, mcfg)[1], params, micro0
+        )
+        zero_aux = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes
+        )
+        (grads, loss, aux), _ = jax.lax.scan(
+            micro, (zero_grads, jnp.zeros(()), zero_aux), batch
+        )
+        inv = 1.0 / accum
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        # Means over microbatches, except token counts which accumulate.
+        aux = {
+            k: v if k == "tokens" else v * inv for k, v in aux.items()
+        }
+        return loss * inv, aux, grads
+
+    def train_step(state: TrainState, batch):
+        params = state["params"]
+        loss, aux, grads = loss_and_grads(params, batch)
+        lr = schedule(state["opt"]["count"]).astype(jnp.float32)
+        new_params, new_opt, opt_metrics = apply_updates(
+            params, grads, state["opt"], cfg.optimizer, lr
+        )
+        new_state = {
+            "params": new_params,
+            "opt": new_opt,
+            "step": state["step"] + 1,
+        }
+        step_metrics = {
+            "loss": loss,
+            "ce_loss": aux["ce_loss"],
+            "moe_aux": aux["moe_aux"],
+            "grad_norm": opt_metrics["grad_norm"],
+            "lr": lr,
+        }
+        return new_state, step_metrics
+
+    return train_step
+
+
+class Trainer:
+    """Builds the distributed runtime and runs the fit loop.
+
+    Call stack mirror of the reference train path (SURVEY.md §4 stack A):
+    runtime.init -> mesh -> loader -> sharded model init or checkpoint
+    restore -> jit train_step -> loop.
+    """
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        if cfg.parallel.pp > 1 or cfg.parallel.sp > 1:
+            # Landed by parallel.pipeline / parallel.ring+ulysses integration;
+            # fail loudly rather than silently replicating work.
+            raise NotImplementedError(
+                "pp/sp mesh axes are not wired into the dense trainer yet"
+            )
+        if cfg.data.batch_size % max(cfg.train.grad_accum, 1):
+            raise ValueError(
+                f"grad_accum={cfg.train.grad_accum} must divide global batch "
+                f"{cfg.data.batch_size}"
+            )
+        initialize(cfg.runtime)
+        self.mesh = build_mesh(cfg.parallel, platform=cfg.runtime.platform)
+        self.shardings = state_shardings(cfg, self.mesh)
+        self.batch_shard = self._batch_sharding()
+        self.loader = make_loader(cfg.data, cfg.model.vocab_size)
+        schedule = make_schedule(cfg.optimizer, cfg.train.num_steps)
+        self.train_step = jax.jit(
+            make_train_step(cfg, schedule), donate_argnums=(0,)
+        )
+        self.ckpt: Optional[CheckpointManager] = None
+        if cfg.checkpoint.directory:
+            self.ckpt = CheckpointManager(
+                cfg.checkpoint.directory, cfg.checkpoint
+            )
+        # data.batch_size is the global batch per optimizer step; grad_accum
+        # only splits it into microbatches and must not inflate throughput.
+        tokens_per_step = cfg.data.batch_size * cfg.data.seq_len
+        self.metrics = metrics_lib.MetricsLogger(
+            flops_per_token=cfg.model.flops_per_token(cfg.data.seq_len),
+            num_devices=self.mesh.size,
+            peak_flops=cfg.train.peak_flops_per_device,
+            jsonl_path=cfg.train.metrics_jsonl,
+            log_interval=cfg.train.log_interval,
+        )
+        self.tokens_per_step = tokens_per_step
+
+    def _batch_sharding(self) -> NamedSharding:
+        shard = batch_sharding(self.mesh)
+        if self.cfg.train.grad_accum > 1:
+            # Microbatch axis leads and is unsharded: [A, b, S].
+            return NamedSharding(self.mesh, P(None, *shard.spec))
+        return shard
+
+    # -- state ------------------------------------------------------------
+
+    def init_state(self) -> TrainState:
+        key = jax.random.key(self.cfg.train.seed)
+        init = lambda: init_train_state(self.cfg, key)
+        return jax.jit(init, out_shardings=self.shardings)()
+
+    def abstract_state(self) -> TrainState:
+        key = jax.random.key(self.cfg.train.seed)
+        shapes = jax.eval_shape(lambda: init_train_state(self.cfg, key))
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes,
+            self.shardings,
+        )
+
+    def restore_or_init(self) -> tuple[TrainState, int]:
+        if self.ckpt is not None and self.cfg.checkpoint.restore:
+            restored = self.ckpt.restore_latest(self.abstract_state())
+            if restored is not None:
+                state, step = restored
+                return state, step
+        return self.init_state(), 0
+
+    # -- data -------------------------------------------------------------
+
+    def global_batch(self, step: int) -> Any:
+        host = dict(self.loader.batch_at(step))
+        accum = self.cfg.train.grad_accum
+        if accum > 1:
+            host = {
+                k: v.reshape(accum, v.shape[0] // accum, *v.shape[1:])
+                for k, v in host.items()
+            }
+        return jax.tree.map(
+            lambda v: jax.make_array_from_process_local_data(
+                self.batch_shard, v
+            ),
+            host,
+        )
+
+    # -- loop -------------------------------------------------------------
+
+    def fit(self, state: Optional[TrainState] = None) -> list:
+        cfg = self.cfg
+        if state is None:
+            state, start = self.restore_or_init()
+        else:
+            start = int(jax.device_get(state["step"]))
+        profile = cfg.train.profile_steps
+        watch = metrics_lib.Stopwatch()
+        tracing = False
+        try:
+            for step in range(start, cfg.train.num_steps):
+                if cfg.train.inject_fault_at_step == step:
+                    raise FaultInjected(f"injected fault at step {step}")
+                if profile and step == profile[0]:
+                    jax.profiler.start_trace(cfg.train.profile_dir)
+                    tracing = True
+                batch = self.global_batch(step)
+                state, m = self.train_step(state, batch)
+                m = jax.device_get(m)
+                dt = watch.lap(sync_on=m["loss"])
+                self.metrics.record(
+                    step=step + 1,
+                    loss=m["loss"],
+                    tokens=self.tokens_per_step,
+                    step_time_s=dt,
+                    grad_norm=m["grad_norm"],
+                    learning_rate=m["lr"],
+                    ce_loss=float(m["ce_loss"]),
+                    moe_aux=float(m["moe_aux"]),
+                )
+                if tracing and step + 1 >= profile[1]:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                if self.ckpt is not None:
+                    self.ckpt.save(step + 1, state)
+            if self.ckpt is not None:
+                self.ckpt.save(cfg.train.num_steps, state, force=True)
+            return self.metrics.history
+        except (KeyboardInterrupt, FaultInjected):
+            # Preemption-safe path: persist the newest complete state, then
+            # re-raise so a supervisor can restart and restore_or_init.
+            # If the interrupt landed inside train_step, `state` is the
+            # donated (deleted) input — in that case the last periodic
+            # checkpoint stands and at most one step is lost.
+            if self.ckpt is not None:
+                try:
+                    self.ckpt.save(
+                        int(jax.device_get(state["step"])), state, force=True
+                    )
+                except RuntimeError:
+                    log.warning(
+                        "state was donated mid-step; relying on last "
+                        "periodic checkpoint"
+                    )
+                self.ckpt.wait()
+            raise
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
+            if self.ckpt is not None:
+                self.ckpt.wait()
+            self.metrics.close()

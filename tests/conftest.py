@@ -1,0 +1,57 @@
+"""Test harness: fake multi-device CPU backend.
+
+SURVEY.md §5: ``--xla_force_host_platform_device_count=8`` gives 8 virtual
+CPU devices — real Mesh, real shard_map, real collective semantics, no
+cluster. This must be in XLA_FLAGS before jax initializes its backends, hence
+the env mutation at module import time (conftest imports before any test).
+
+Gotcha (SURVEY.md §5): a sitecustomize on this machine force-registers the
+axon TPU plugin and overrides ``JAX_PLATFORMS=cpu``, so tests select the CPU
+backend explicitly via ``jax.devices("cpu")`` and a cpu default-device
+fixture, never via the env var.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (after XLA_FLAGS)
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 fake CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _default_to_cpu():
+    """Run every test on CPU so results are fast and deterministic even on a
+    box whose default backend is the axon TPU plugin."""
+    cpu0 = jax.devices("cpu")[0]
+    with jax.default_device(cpu0):
+        yield
+
+
+@pytest.fixture()
+def mesh8(cpu_devices):
+    """A dp=8 mesh over the fake CPU devices (all other axes size 1)."""
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.runtime import build_mesh
+
+    return build_mesh(ParallelConfig(dp=8), devices=cpu_devices[:8])
+
+
+def make_mesh(cpu_devices, **axes):
+    """Helper: build a mesh with the given axis sizes over fake CPU devices."""
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.runtime import build_mesh
+
+    cfg = ParallelConfig(**axes)
+    return build_mesh(cfg, devices=cpu_devices[: cfg.num_devices])

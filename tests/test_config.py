@@ -1,0 +1,87 @@
+"""Unit tests for the config system (SURVEY.md §6 config/flag system)."""
+
+import pytest
+
+from orion_tpu.config import (
+    Config,
+    ParallelConfig,
+    apply_overrides,
+    get_config,
+    list_presets,
+)
+
+
+def test_presets_cover_baseline_workloads():
+    # The five BASELINE.json workloads must all have presets.
+    names = list_presets()
+    for required in (
+        "gpt2-125m",
+        "llama3-8b-dp",
+        "llama3-70b-fsdp",
+        "mixtral-8x7b-ep",
+        "llama3-8b-infer",
+    ):
+        assert required in names
+
+
+def test_overrides_typed():
+    cfg = get_config("tiny", ["model.n_layers=3", "data.batch_size=2",
+                              "optimizer.learning_rate=1e-3",
+                              "model.tie_embeddings=false"])
+    assert cfg.model.n_layers == 3
+    assert cfg.data.batch_size == 2
+    assert cfg.optimizer.learning_rate == pytest.approx(1e-3)
+    assert cfg.model.tie_embeddings is False
+
+
+def test_overrides_optional_and_tuple_types():
+    # Regression: `from __future__ import annotations` stringifies field types;
+    # overrides must still resolve Optional[int] / Tuple[...] correctly.
+    cfg = apply_overrides(Config(), [
+        "model.head_dim=64",
+        "optimizer.decay_steps=2000",
+        "train.profile_steps=10,20",
+        "parallel.dcn_axes=dp",
+        "model.head_dim=none",
+    ])
+    assert cfg.model.head_dim is None
+    assert cfg.optimizer.decay_steps == 2000
+    assert cfg.train.profile_steps == (10, 20)
+    assert cfg.parallel.dcn_axes == ("dp",)
+
+
+def test_override_unknown_key_raises():
+    with pytest.raises(ValueError, match="unknown config key"):
+        apply_overrides(Config(), ["model.not_a_field=1"])
+
+
+def test_parallel_num_devices():
+    p = ParallelConfig(dp=2, fsdp=2, tp=2)
+    assert p.num_devices == 8
+
+
+def test_param_count_sane():
+    gpt2 = get_config("gpt2-125m").model
+    # GPT-2 125M: ~124M params (with the padded 50304 vocab).
+    n = gpt2.num_params()
+    assert 100e6 < n < 180e6
+
+    llama = get_config("llama3-8b-dp").model
+    n = llama.num_params()
+    assert 7e9 < n < 9e9
+
+    llama70 = get_config("llama3-70b-fsdp").model
+    assert 65e9 < llama70.num_params() < 75e9
+
+
+def test_moe_flops_use_active_experts_only():
+    mix = get_config("mixtral-8x7b-ep").model
+    dense_equiv = mix.flops_per_token()
+    # Active params ~13B of 47B total: flops must be well under total-param flops.
+    assert dense_equiv < 6 * mix.num_params()
+
+
+def test_config_json_roundtrip():
+    cfg = get_config("tiny")
+    s = cfg.to_json()
+    assert '"n_layers": 2' in s

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Training entry point (reference top-level ``train.py``, BASELINE.json:5,7).
+
+Usage:
+    python train.py --preset gpt2-125m [section.key=value ...]
+
+Examples:
+    python train.py --preset tiny train.num_steps=50          # CPU smoke
+    python train.py --preset llama3-8b-dp                      # v5p-64 DDP
+    python train.py --preset llama3-70b-fsdp parallel.fsdp=64  # ZeRO-3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--preset", default="gpt2-125m")
+    parser.add_argument("--list-presets", action="store_true")
+    parser.add_argument("--print-config", action="store_true")
+    parser.add_argument(
+        "overrides", nargs="*", help="dotted config overrides, e.g. model.n_layers=4"
+    )
+    args = parser.parse_args(argv)
+
+    from orion_tpu.config import get_config, list_presets
+
+    if args.list_presets:
+        print("\n".join(list_presets()))
+        return 0
+
+    cfg = get_config(args.preset, args.overrides)
+    if args.print_config:
+        print(cfg.to_json())
+        return 0
+
+    from orion_tpu.train import Trainer
+
+    trainer = Trainer(cfg)
+    history = trainer.fit()
+    if history:
+        last = history[-1]
+        print(
+            f"done: {last.step} steps, final loss {last.loss:.4f}, "
+            f"mean MFU {sum(h.mfu for h in history) / len(history) * 100:.2f}%"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
