@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Benchmark: tokens/sec/chip + MFU on the flagship Llama-family model.
+
+The judged metric (BASELINE.json:2) is tokens/sec/chip + MFU for Llama-3-8B
+on v5p; the dev box has one v5e-class chip, so this benchmarks the flagship
+architecture at a size that saturates a single chip (llama-1b-bench preset:
+Llama-3 architecture, bf16, remat, fused kernels when enabled) and reports
+MFU against the 45% north-star (BASELINE.json:5).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+BASELINE_MFU = 0.45  # north-star target, BASELINE.json:5
+
+WARMUP_STEPS = 3  # excluded from timing (includes XLA compile)
+
+
+def main() -> int:
+    import jax
+
+    from orion_tpu.config import get_config
+    from orion_tpu.train import Trainer
+
+    overrides = sys.argv[1:]
+    cfg = get_config("llama-1b-bench", overrides)
+    trainer = Trainer(cfg)
+    history = trainer.fit()
+
+    steady = history[WARMUP_STEPS:]
+    if not steady:
+        print(json.dumps({"error": "no steady-state steps"}))
+        return 1
+    mean_tps = sum(m.tokens_per_sec_per_device for m in steady) / len(steady)
+    mean_mfu = sum(m.mfu for m in steady) / len(steady)
+    dev = jax.devices()[0]
+
+    result = {
+        "metric": "llama_flagship_train_mfu",
+        "value": round(mean_mfu * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mean_mfu / BASELINE_MFU, 4),
+        "tokens_per_sec_per_chip": round(mean_tps, 1),
+        "device": dev.device_kind,
+        "model": cfg.model.name,
+        "steps_timed": len(steady),
+        "final_loss": round(steady[-1].loss, 4),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -504,8 +504,8 @@ def _p_llama_bench() -> Config:
         model=_llama3_8b_model(name="llama-1b", vocab_size=32768,
                                max_seq_len=2048, d_model=2048, n_layers=16,
                                n_heads=16, n_kv_heads=8, d_ff=7168,
-                               remat="none"),
-        data=DataConfig(batch_size=8, seq_len=2048),
-        optimizer=OptimizerConfig(moment_dtype="float32"),
-        train=TrainConfig(num_steps=30, log_interval=5),
+                               remat="full"),
+        data=DataConfig(batch_size=4, seq_len=2048),
+        optimizer=OptimizerConfig(moment_dtype="bfloat16", warmup_steps=5),
+        train=TrainConfig(num_steps=20, log_interval=5),
     )
