@@ -27,7 +27,9 @@ def main() -> int:
     from orion_tpu.config import get_config
     from orion_tpu.train import Trainer
 
-    overrides = sys.argv[1:]
+    # Silence per-step logging so stdout is exactly one JSON line; user
+    # overrides can still re-enable it.
+    overrides = ["train.log_interval=100000"] + sys.argv[1:]
     cfg = get_config("llama-1b-bench", overrides)
     trainer = Trainer(cfg)
     history = trainer.fit()

@@ -1,0 +1,23 @@
+"""Kernel-implementation dispatch shared by the op wrappers.
+
+``ModelConfig.kernels`` selects the op backend:
+  - "xla"              — pure-jnp reference path (CPU/test default)
+  - "pallas"           — compiled Pallas TPU kernels
+  - "pallas_interpret" — same kernels through the Pallas interpreter (for
+                         the fake-CPU-device test mesh, SURVEY.md §5)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_VALID = ("xla", "pallas", "pallas_interpret")
+
+
+def resolve_impl(impl: str) -> tuple[bool, Optional[bool]]:
+    """-> (use_pallas, interpret); interpret=None means autodetect."""
+    if impl not in _VALID:
+        raise ValueError(f"unknown kernel impl {impl!r}; expected one of {_VALID}")
+    if impl == "xla":
+        return False, None
+    return True, (True if impl == "pallas_interpret" else None)

@@ -106,7 +106,15 @@ def attention(
     impl: str = "xla",
 ) -> jax.Array:
     """Grouped-query scaled-dot-product attention. Shapes as attention_xla."""
-    if impl == "pallas":
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(impl)
+    if use_pallas:
+        if mask is not None:
+            raise ValueError(
+                "explicit `mask` is only supported by impl='xla'; express the "
+                "mask via causal/q_segment_ids for the flash kernel"
+            )
         from orion_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
@@ -118,6 +126,7 @@ def attention(
             kv_segment_ids=kv_segment_ids,
             logit_softcap=logit_softcap,
             q_offset=q_offset,
+            interpret=interpret,
         )
     return attention_xla(
         q,

@@ -45,10 +45,13 @@ def rmsnorm(
     impl: str = "xla",
 ) -> jax.Array:
     """Root-mean-square normalization over the last axis."""
-    if impl == "pallas":
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(impl)
+    if use_pallas:
         from orion_tpu.ops.pallas.norms import rmsnorm_pallas
 
-        return rmsnorm_pallas(x, scale, eps=eps)
+        return rmsnorm_pallas(x, scale, eps=eps, interpret=interpret)
     return _rmsnorm_xla(x, scale, eps)
 
 

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels — the L0 native-op layer (reference ``orion.ops``).
+
+The reference's fused CUDA kernels (attention / RoPE / RMSNorm,
+BASELINE.json:5) map to these Mosaic-lowered Pallas kernels. Each has an
+interpret mode so the identical kernel code runs on the fake-CPU-device test
+mesh (SURVEY.md §5) and is parity-tested against the jnp/XLA reference ops.
+"""
+
+from orion_tpu.ops.pallas.flash_attention import flash_attention
+from orion_tpu.ops.pallas.norms import rmsnorm_pallas
+from orion_tpu.ops.pallas.rope import rope_pallas
+
+__all__ = ["flash_attention", "rmsnorm_pallas", "rope_pallas"]

@@ -1,0 +1,39 @@
+"""Shared helpers for the Pallas TPU kernels (reference ``orion.ops`` L0).
+
+All kernels in this package follow the same conventions:
+
+- Block shapes are static; callers pad to block multiples and the kernels
+  mask padded positions (XLA/Mosaic require static shapes, SURVEY.md §8).
+- Math is float32 inside the kernel regardless of the activation dtype
+  (bf16-safe convention shared with the xla reference ops).
+- ``interpret=True`` runs the kernel through the Pallas interpreter so the
+  same code is testable on the fake-CPU-device mesh (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import jax
+
+NEG_INF = -1e30  # finite -inf stand-in: exp(NEG_INF - m) underflows to 0.
+
+
+def resolve_interpret(interpret) -> bool:
+    """None -> autodetect: compiled on TPU, interpreted elsewhere."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def pad_axis(x: jax.Array, axis: int, target: int) -> jax.Array:
+    """Zero-pad ``axis`` of x up to length ``target``."""
+    if x.shape[axis] == target:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, target - x.shape[axis])
+    import jax.numpy as jnp
+
+    return jnp.pad(x, pads)

@@ -1,0 +1,440 @@
+"""Flash attention as a Pallas TPU kernel, forward + custom VJP.
+
+TPU-native equivalent of the reference's fused CUDA attention in ``orion.ops``
+(BASELINE.json:5); semantics match ``orion_tpu.ops.attention.attention_xla``
+exactly: grouped-query causal attention, optional segment masking (packed
+sequences), logit soft-capping, and a ``q_offset`` for decode steps.
+
+Design (SURVEY.md §8 hard-part #1):
+
+- Layout inside the kernel is [batch, heads, seq, head_dim]; the public
+  wrapper transposes from the model's [B, S, N, H].
+- Grid is (batch, q_head, q_block, kv_block) with the kv block innermost, so
+  the online-softmax state (m, l, acc) lives in VMEM scratch carried across
+  the kv iterations of one q block.
+- GQA is expressed through the k/v BlockSpec index maps (q head n reads kv
+  head n * K // N); the backward dk/dv kernel accumulates over the group.
+- Causal skipping: blocks strictly above the diagonal skip their compute via
+  ``pl.when`` (DMAs still happen — acceptable; revisit with a kv-bound grid).
+- The backward pass recomputes attention probabilities from saved (lse) as in
+  the flash-attention-2 formulation: two kernels, one accumulating dq over kv
+  blocks, one accumulating dk/dv over (group, q-block).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from orion_tpu.ops.pallas.common import NEG_INF, pad_axis, resolve_interpret, round_up
+
+LANES = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class _Statics:
+    """Hashable static config for the custom-VJP core."""
+
+    causal: bool
+    logit_softcap: Optional[float]
+    q_offset: int
+    # Unpadded kv length: padded kv columns are masked in-kernel. Padded q
+    # ROWS are deliberately not masked — they produce garbage that the
+    # wrapper slices off, and their cotangents are zero in backward.
+    seq_kv: int
+    block_q: int
+    block_kv: int
+    interpret: bool
+
+
+def _block_mask(st: _Statics, iq, ik, qseg_ref, kseg_ref):
+    """[bq, bk] bool mask for grid cell (iq, ik); True = attend.
+
+    qseg_ref/kseg_ref hold the FULL padded sequence of segment ids (blocked
+    (1, 1, S) — TPU tiling forbids (1, bq) blocks); sliced here by grid cell.
+    """
+    bq, bk = st.block_q, st.block_kv
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kv_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = kv_pos < st.seq_kv  # kv padding
+    if st.causal:
+        mask &= (q_pos + st.q_offset) >= kv_pos
+    if qseg_ref is not None:
+        q_ids = qseg_ref[0, 0, pl.ds(iq * bq, bq)]
+        kv_ids = kseg_ref[0, 0, pl.ds(ik * bk, bk)]
+        mask &= q_ids[:, None] == kv_ids[None, :]
+    return mask
+
+
+def _scaled_logits(st: _Statics, q, k, scale):
+    """Returns (z, dz_dscale_factor) where z is the softcapped logit block.
+
+    The second value is tanh(s/cap) (needed by backward) or None.
+    """
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if st.logit_softcap is not None:
+        t = jnp.tanh(s / st.logit_softcap)
+        return st.logit_softcap * t, t
+    return s, None
+
+
+def _fwd_kernel(st: _Statics, has_seg, *refs):
+    if has_seg:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        qseg, kseg = qseg_ref, kseg_ref
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        qseg = kseg = None
+
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq = st.block_q
+    scale = q_ref.shape[-1] ** -0.5
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # Skip blocks strictly above the causal diagonal.
+    q_max = iq * bq + bq - 1 + st.q_offset
+    run = (not st.causal) | (ik * st.block_kv <= q_max)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        z, _ = _scaled_logits(st, q, k, scale)
+        mask = _block_mask(st, iq, ik, qseg, kseg)
+        z = jnp.where(mask, z, NEG_INF)
+
+        m_prev = m_s[:, :1]                       # [bq, 1]
+        m_new = jnp.maximum(m_prev, z.max(axis=-1, keepdims=True))
+        # Masked rows keep m == NEG_INF; exp(z - m) would be exp(0) = 1
+        # there, so re-apply the mask multiplicatively.
+        p = jnp.exp(z - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)           # [bq, 1]
+        l_new = l_s[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse = m_s[:, :1] + jnp.log(l_safe)
+        lse = jnp.where(l == 0.0, NEG_INF, lse)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _dq_kernel(st: _Statics, has_seg, *refs):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref, dq_s) = refs
+        qseg, kseg = qseg_ref, kseg_ref
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s = refs
+        qseg = kseg = None
+
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq = st.block_q
+    scale = q_ref.shape[-1] ** -0.5
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    q_max = iq * bq + bq - 1 + st.q_offset
+    run = (not st.causal) | (ik * st.block_kv <= q_max)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        z, t = _scaled_logits(st, q, k, scale)
+        mask = _block_mask(st, iq, ik, qseg, kseg)
+        lse = lse_ref[0, 0][:, :1]                # [bq, 1] (lanes-broadcast)
+        p = jnp.exp(z - lse) * mask.astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dz = p * (dp - delta_ref[0, 0][:, :1])
+        ds = dz if t is None else dz * (1.0 - t * t)
+        dq_s[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(st: _Statics, has_seg, *refs):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
+        qseg, kseg = qseg_ref, kseg_ref
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+        qseg = kseg = None
+
+    # grid = (batch, kv_head, kv_block, group, q_block)
+    ik, g, iq = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    ng, nq = pl.num_programs(3), pl.num_programs(4)
+    bq = st.block_q
+    scale = q_ref.shape[-1] ** -0.5
+
+    @pl.when((g == 0) & (iq == 0))
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    q_max = iq * bq + bq - 1 + st.q_offset
+    run = (not st.causal) | (ik * st.block_kv <= q_max)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        z, t = _scaled_logits(st, q, k, scale)
+        mask = _block_mask(st, iq, ik, qseg, kseg)
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.exp(z - lse) * mask.astype(jnp.float32)
+        dv_s[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dz = p * (dp - delta_ref[0, 0][:, :1])
+        ds = dz if t is None else dz * (1.0 - t * t)
+        dk_s[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when((g == ng - 1) & (iq == nq - 1))
+    def _finish():
+        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _seg_specs(Sq_p: int, Skv_p: int, batch_index):
+    """Full-sequence (1, 1, S) segment-id blocks (TPU tiling-legal); the
+    kernels slice the current block's ids with pl.ds."""
+    return [
+        pl.BlockSpec((1, 1, Sq_p), batch_index),
+        pl.BlockSpec((1, 1, Skv_p), batch_index),
+    ]
+
+
+def _fwd_call(st: _Statics, q, k, v, qseg, kseg):
+    """q: [B,N,Sq,H]; k,v: [B,K,Skv,H] (padded) -> (o, lse[f32 B,N,Sq])."""
+    B, N, Sq, H = q.shape
+    K, Skv = k.shape[1], k.shape[2]
+    G = N // K
+    nq, nk = Sq // st.block_q, Skv // st.block_kv
+    grid = (B, N, nq, nk)
+
+    q_spec = pl.BlockSpec((1, 1, st.block_q, H), lambda b, n, iq, ik: (b, n, iq, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, st.block_kv, H), lambda b, n, iq, ik: (b, n // G, ik, 0)
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q, k, v]
+    if qseg is not None:
+        in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
+        args += [qseg, kseg]
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, st, qseg is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, st.block_q, H), lambda b, n, iq, ik: (b, n, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, st.block_q, LANES), lambda b, n, iq, ik: (b, n, iq, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            # lse is lanes-broadcast [B, N, Sq, 128]: TPU tiling forbids a
+            # (1, 1, block_q) block, so the row stat rides a full lane dim.
+            jax.ShapeDtypeStruct((B, N, Sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((st.block_q, LANES), jnp.float32),
+            pltpu.VMEM((st.block_q, LANES), jnp.float32),
+            pltpu.VMEM((st.block_q, H), jnp.float32),
+        ],
+        interpret=st.interpret,
+    )(*args)
+    return out[0], out[1]
+
+
+def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do):
+    B, N, Sq, H = q.shape
+    K, Skv = k.shape[1], k.shape[2]
+    G = N // K
+    nq, nk = Sq // st.block_q, Skv // st.block_kv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B, N, Sq, LANES))
+
+    q_spec4 = pl.BlockSpec((1, 1, st.block_q, H), lambda b, n, iq, ik: (b, n, iq, 0))
+    kv_spec4 = pl.BlockSpec(
+        (1, 1, st.block_kv, H), lambda b, n, iq, ik: (b, n // G, ik, 0)
+    )
+    row_spec4 = pl.BlockSpec(
+        (1, 1, st.block_q, LANES), lambda b, n, iq, ik: (b, n, iq, 0)
+    )
+    in_specs = [q_spec4, kv_spec4, kv_spec4, q_spec4, row_spec4, row_spec4]
+    args = [q, k, v, do, lse, delta]
+    if qseg is not None:
+        in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
+        args += [qseg, kseg]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, st, qseg is not None),
+        grid=(B, N, nq, nk),
+        in_specs=in_specs,
+        out_specs=q_spec4,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((st.block_q, H), jnp.float32)],
+        interpret=st.interpret,
+    )(*args)
+
+    # grid = (batch, kv_head, kv_block, group, q_block): the dk/dv output
+    # block for (b, kh, ik) is revisited across the two inner dims, so the
+    # accumulator scratch carries over the whole group x q sweep.
+    def _q_map5(b, kh, ik, g, iq):
+        return (b, kh * G + g, iq, 0)
+
+    def _row_map5(b, kh, ik, g, iq):
+        return (b, kh * G + g, iq, 0)
+
+    q_spec5 = pl.BlockSpec((1, 1, st.block_q, H), _q_map5)
+    kv_spec5 = pl.BlockSpec(
+        (1, 1, st.block_kv, H), lambda b, kh, ik, g, iq: (b, kh, ik, 0)
+    )
+    row_spec5 = pl.BlockSpec((1, 1, st.block_q, LANES), _row_map5)
+    in_specs5 = [q_spec5, kv_spec5, kv_spec5, q_spec5, row_spec5, row_spec5]
+    args5 = [q, k, v, do, lse, delta]
+    if qseg is not None:
+        in_specs5 += _seg_specs(Sq, Skv, lambda b, kh, ik, g, iq: (b, 0, 0))
+        args5 += [qseg, kseg]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, st, qseg is not None),
+        grid=(B, K, nk, G, nq),
+        in_specs=in_specs5,
+        out_specs=[kv_spec5, kv_spec5],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((st.block_kv, H), jnp.float32),
+            pltpu.VMEM((st.block_kv, H), jnp.float32),
+        ],
+        interpret=st.interpret,
+    )(*args5)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(st: _Statics, q, k, v, qseg, kseg):
+    o, _ = _fwd_call(st, q, k, v, qseg, kseg)
+    return o
+
+
+def _flash_fwd(st, q, k, v, qseg, kseg):
+    o, lse = _fwd_call(st, q, k, v, qseg, kseg)
+    return o, (q, k, v, qseg, kseg, o, lse)
+
+
+def _flash_bwd(st, res, do):
+    q, k, v, qseg, kseg, o, lse = res
+    dq, dk, dv = _bwd_call(st, q, k, v, qseg, kseg, o, lse, do)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+    q_offset: int = 0,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention; shapes/semantics match ``attention_xla``.
+
+    q: [B, Sq, N, H]; k, v: [B, Skv, K, H] with N % K == 0 -> [B, Sq, N, H].
+    """
+    assert (q_segment_ids is None) == (kv_segment_ids is None)
+    B, Sq, N, H = q.shape
+    Skv, K = k.shape[1], k.shape[2]
+    assert N % K == 0, (N, K)
+
+    bq = min(block_q, round_up(Sq, 8))
+    bk = min(block_kv, round_up(Skv, 8))
+    Sq_p, Skv_p = round_up(Sq, bq), round_up(Skv, bk)
+
+    st = _Statics(
+        causal=causal,
+        logit_softcap=logit_softcap,
+        q_offset=q_offset,
+        seq_kv=Skv,
+        block_q=bq,
+        block_kv=bk,
+        interpret=resolve_interpret(interpret),
+    )
+
+    qt = pad_axis(q.transpose(0, 2, 1, 3), 2, Sq_p)
+    kt = pad_axis(k.transpose(0, 2, 1, 3), 2, Skv_p)
+    vt = pad_axis(v.transpose(0, 2, 1, 3), 2, Skv_p)
+    qseg = kseg = None
+    if q_segment_ids is not None:
+        # (B, 1, S) so the full-seq segment blocks are TPU tiling-legal.
+        qseg = pad_axis(q_segment_ids.astype(jnp.int32), 1, Sq_p)[:, None, :]
+        kseg = pad_axis(kv_segment_ids.astype(jnp.int32), 1, Skv_p)[:, None, :]
+
+    o = _flash(st, qt, kt, vt, qseg, kseg)
+    return o[:, :, :Sq, :].transpose(0, 2, 1, 3)

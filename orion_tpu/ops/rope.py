@@ -38,10 +38,13 @@ def apply_rope(
 
     x: [B, S, N, H]; positions: [B, S] (or [S], broadcast over batch).
     """
-    if impl == "pallas":
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(impl)
+    if use_pallas:
         from orion_tpu.ops.pallas.rope import rope_pallas
 
-        return rope_pallas(x, positions, theta=theta)
+        return rope_pallas(x, positions, theta=theta, interpret=interpret)
     return _rope_xla(x, positions, theta)
 
 

@@ -1,0 +1,230 @@
+"""Unit tier: Pallas kernels vs jnp/XLA reference implementations.
+
+SURVEY.md §5: kernels run through the Pallas interpreter on CPU so the same
+code paths are exercised without a TPU; fwd and grads must match the xla ops
+to fp32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ops.attention import attention_xla
+from orion_tpu.ops.norms import _rmsnorm_xla
+from orion_tpu.ops.pallas import flash_attention, rmsnorm_pallas, rope_pallas
+from orion_tpu.ops.rope import _rope_xla
+
+
+def _rand(key, *shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.key(key), shape, dtype=dtype)
+
+
+def _qkv(B=2, Sq=64, Skv=64, N=4, K=4, H=32, dtype=jnp.float32):
+    return (
+        _rand(0, B, Sq, N, H, dtype=dtype),
+        _rand(1, B, Skv, K, H, dtype=dtype),
+        _rand(2, B, Skv, K, H, dtype=dtype),
+    )
+
+
+class TestFlashAttention:
+    def test_causal_fwd(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = attention_xla(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_non_causal_fwd(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=False, interpret=True)
+        ref = attention_xla(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(N=8, K=2)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = attention_xla(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_multiple_kv_blocks(self):
+        # Sequence longer than one block forces the online-softmax carry.
+        q, k, v = _qkv(Sq=160, Skv=160)
+        out = flash_attention(q, k, v, block_q=64, block_kv=64, interpret=True)
+        ref = attention_xla(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_ragged_padding(self):
+        # Non-multiple-of-block lengths exercise the padding mask.
+        q, k, v = _qkv(Sq=100, Skv=100)
+        out = flash_attention(q, k, v, block_q=64, block_kv=64, interpret=True)
+        ref = attention_xla(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_segment_ids(self):
+        q, k, v = _qkv()
+        seg = jnp.concatenate(
+            [jnp.zeros((2, 32), jnp.int32), jnp.ones((2, 32), jnp.int32)], axis=1
+        )
+        out = flash_attention(
+            q, k, v, q_segment_ids=seg, kv_segment_ids=seg, interpret=True
+        )
+        ref = attention_xla(q, k, v, q_segment_ids=seg, kv_segment_ids=seg)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_softcap(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, logit_softcap=20.0, interpret=True)
+        ref = attention_xla(q, k, v, logit_softcap=20.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_q_offset_decode(self):
+        # Decode-style: 8 new queries attending into a longer kv history.
+        q, k, v = _qkv(Sq=8, Skv=72)
+        out = flash_attention(q, k, v, q_offset=64, interpret=True)
+        ref = attention_xla(q, k, v, q_offset=64)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("case", ["mha", "gqa", "softcap", "ragged"])
+    def test_grads_match_xla(self, case):
+        kw = {}
+        if case == "gqa":
+            q, k, v = _qkv(N=8, K=2)
+        elif case == "softcap":
+            q, k, v = _qkv()
+            kw["logit_softcap"] = 20.0
+        elif case == "ragged":
+            q, k, v = _qkv(Sq=100, Skv=100)
+        else:
+            q, k, v = _qkv()
+
+        def loss_pallas(q, k, v):
+            o = flash_attention(
+                q, k, v, interpret=True, block_q=64, block_kv=64, **kw
+            )
+            return jnp.sum(o * o)
+
+        def loss_xla(q, k, v):
+            o = attention_xla(q, k, v, **kw)
+            return jnp.sum(o * o)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gp, gx, "qkv"):
+            np.testing.assert_allclose(
+                a, b, rtol=2e-4, atol=2e-4, err_msg=f"d{name} mismatch"
+            )
+
+    def test_grads_segment_ids(self):
+        q, k, v = _qkv()
+        seg = jnp.concatenate(
+            [jnp.zeros((2, 32), jnp.int32), jnp.ones((2, 32), jnp.int32)], axis=1
+        )
+
+        def lp(q, k, v):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, q_segment_ids=seg, kv_segment_ids=seg, interpret=True
+                ) ** 2
+            )
+
+        def lx(q, k, v):
+            return jnp.sum(
+                attention_xla(q, k, v, q_segment_ids=seg, kv_segment_ids=seg) ** 2
+            )
+
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+    def test_bf16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = attention_xla(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref.astype(jnp.float32), rtol=2e-2, atol=2e-2
+        )
+
+
+class TestRMSNorm:
+    def test_fwd(self):
+        x = _rand(0, 4, 96, 128)
+        s = _rand(1, 128) * 0.1 + 1.0
+        out = rmsnorm_pallas(x, s, eps=1e-5, interpret=True)
+        ref = _rmsnorm_xla(x, s, 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fwd_ragged_rows(self):
+        x = _rand(0, 3, 37, 64)
+        s = _rand(1, 64)
+        out = rmsnorm_pallas(x, s, eps=1e-6, interpret=True, block_rows=32)
+        ref = _rmsnorm_xla(x, s, 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads(self):
+        x = _rand(0, 2, 24, 64)
+        s = _rand(1, 64) * 0.1 + 1.0
+
+        def lp(x, s):
+            return jnp.sum(rmsnorm_pallas(x, s, eps=1e-5, interpret=True) ** 2)
+
+        def lx(x, s):
+            return jnp.sum(_rmsnorm_xla(x, s, 1e-5) ** 2)
+
+        gp = jax.grad(lp, argnums=(0, 1))(x, s)
+        gx = jax.grad(lx, argnums=(0, 1))(x, s)
+        np.testing.assert_allclose(gp[0], gx[0], rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gp[1], gx[1], rtol=1e-4, atol=1e-4)
+
+
+class TestRoPE:
+    def test_fwd(self):
+        x = _rand(0, 2, 48, 4, 32)
+        pos = jnp.broadcast_to(jnp.arange(48)[None, :], (2, 48))
+        out = rope_pallas(x, pos, theta=10_000.0, interpret=True)
+        ref = _rope_xla(x, pos, 10_000.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fwd_1d_positions_and_offset(self):
+        # Decode: positions far from zero.
+        x = _rand(0, 2, 8, 4, 32)
+        pos = jnp.arange(1000, 1008)
+        out = rope_pallas(x, pos, theta=500_000.0, interpret=True)
+        ref = _rope_xla(x, pos, 500_000.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_grads(self):
+        x = _rand(0, 1, 16, 2, 16)
+        pos = jnp.arange(16)[None, :]
+
+        def lp(x):
+            return jnp.sum(rope_pallas(x, pos, theta=10_000.0, interpret=True) ** 2)
+
+        def lx(x):
+            return jnp.sum(_rope_xla(x, pos, 10_000.0) ** 2)
+
+        gp = jax.grad(lp)(x)
+        gx = jax.grad(lx)(x)
+        np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=1e-4)
+
+
+class TestModelWithPallasKernels:
+    def test_forward_matches_xla_kernels(self):
+        """Whole-model parity: tiny llama with kernels=pallas_interpret."""
+        from orion_tpu.config import get_config
+        from orion_tpu.models import forward, init_params
+
+        cfg = get_config("tiny-llama", ["model.dtype=float32"]).model
+        params = init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
+
+        logits_xla, _ = forward(params, tokens, cfg)
+        import dataclasses
+
+        cfg_p = dataclasses.replace(cfg, kernels="pallas_interpret")
+        logits_pallas, _ = forward(params, tokens, cfg_p)
+        np.testing.assert_allclose(
+            logits_pallas, logits_xla, rtol=5e-4, atol=5e-4
+        )
