@@ -66,6 +66,12 @@ class ModelConfig:
     # "xla" uses the pure-jnp reference path (also the CPU/test path).
     kernels: str = "xla"
 
+    # Sequence/context parallelism for attention. When sequence_axis names a
+    # mesh axis of size > 1 (the trainer sets this from ParallelConfig.sp),
+    # attention runs as ring attention or Ulysses over that axis.
+    sequence_axis: Optional[str] = None
+    sequence_method: str = "ring"   # "ring" | "ulysses"
+
     # Gradient checkpointing policy for the layer scan:
     # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
     remat: str = "none"

@@ -186,6 +186,7 @@ def _attn_block(
     cfg: ModelConfig,
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
+    mesh: Optional[Any] = None,
 ) -> jax.Array:
     B, S, _ = x.shape
     N, K, H = cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim
@@ -206,16 +207,38 @@ def _attn_block(
         q = ops.apply_rope(q, positions, theta=cfg.rope_theta, impl=cfg.kernels)
         k = ops.apply_rope(k, positions, theta=cfg.rope_theta, impl=cfg.kernels)
 
-    out = ops.attention(
-        q,
-        k,
-        v,
-        causal=True,
-        q_segment_ids=segment_ids,
-        kv_segment_ids=segment_ids,
-        logit_softcap=cfg.attn_logit_softcap,
-        impl=cfg.kernels,
+    sp_active = (
+        cfg.sequence_axis is not None
+        and mesh is not None
+        and mesh.shape.get(cfg.sequence_axis, 1) > 1
     )
+    if sp_active:
+        from orion_tpu.parallel.sequence import sequence_attention
+
+        out = sequence_attention(
+            q,
+            k,
+            v,
+            mesh,
+            method=cfg.sequence_method,
+            axis=cfg.sequence_axis,
+            causal=True,
+            q_segment_ids=segment_ids,
+            kv_segment_ids=segment_ids,
+            logit_softcap=cfg.attn_logit_softcap,
+            impl=cfg.kernels,
+        )
+    else:
+        out = ops.attention(
+            q,
+            k,
+            v,
+            causal=True,
+            q_segment_ids=segment_ids,
+            kv_segment_ids=segment_ids,
+            logit_softcap=cfg.attn_logit_softcap,
+            impl=cfg.kernels,
+        )
     out = out.reshape(B, S, N * H)
     y = jnp.einsum("bsh,hd->bsd", out, p["wo"].astype(dtype))
     if cfg.attn_bias:
@@ -245,10 +268,11 @@ def _block(
     cfg: ModelConfig,
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
+    mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One transformer block. Returns (x, moe_aux_loss)."""
     x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
-                        positions, segment_ids)
+                        positions, segment_ids, mesh)
     h = _norm(x, bp["mlp_norm"], cfg)
     if cfg.is_moe:
         moe_params = {
@@ -269,6 +293,7 @@ def forward(
     *,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """tokens: [B, S] int32 -> (logits [B, S, V] float32, moe_aux scalar)."""
     dtype = jnp.dtype(cfg.dtype)
@@ -281,7 +306,7 @@ def forward(
         x = x + params["embed"]["positions"].astype(dtype)[positions]
 
     def block_fn(carry, bp):
-        y, aux = _block(carry, bp, cfg, positions, segment_ids)
+        y, aux = _block(carry, bp, cfg, positions, segment_ids, mesh)
         return y, aux
 
     if cfg.remat == "full":
@@ -315,6 +340,7 @@ def loss_fn(
     params: Params,
     batch: dict[str, jax.Array],
     cfg: ModelConfig,
+    mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Next-token cross-entropy + weighted MoE aux loss.
 
@@ -327,6 +353,7 @@ def loss_fn(
         cfg,
         positions=batch.get("positions"),
         segment_ids=batch.get("segment_ids"),
+        mesh=mesh,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]

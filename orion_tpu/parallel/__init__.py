@@ -17,6 +17,11 @@ from orion_tpu.parallel.sharding import (
     param_shardings,
     shard_init,
 )
+from orion_tpu.parallel.sequence import (
+    ring_attention,
+    sequence_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "DEFAULT_RULES",
@@ -24,4 +29,7 @@ __all__ = [
     "logical_to_spec",
     "param_shardings",
     "shard_init",
+    "ring_attention",
+    "sequence_attention",
+    "ulysses_attention",
 ]

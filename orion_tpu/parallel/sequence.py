@@ -1,0 +1,345 @@
+"""Sequence / context parallelism: ring attention and Ulysses.
+
+The reference reaches long contexts by sharding the sequence axis over
+devices and moving KV blocks (ring, NCCL p2p) or resharding heads<->sequence
+(Ulysses, NCCL all-to-all) around attention (SURVEY.md §3 "SP / CP / ring
+attention", "Ulysses"). TPU-native equivalents, per SURVEY.md §6
+"Long-context":
+
+  - **ring attention** — activations stay sequence-sharded on the ``sp`` mesh
+    axis; inside a ``shard_map``, KV blocks rotate around the ``sp`` ring via
+    ``lax.ppermute`` while each device accumulates blockwise-stable softmax
+    (log-sum-exp merge) for its local queries. Communication is O(S/sp) per
+    step and overlaps with the block matmuls under XLA latency hiding.
+  - **Ulysses** — a head<->sequence ``lax.all_to_all`` gives every device the
+    full sequence for a 1/sp slice of heads; plain (flash) attention runs
+    locally, then the inverse all-to-all restores sequence sharding.
+
+Both compose with the batch (dp/fsdp) and head (tp) mesh axes: all specs
+below carry those axes through the shard_map. Everything is differentiable
+(ppermute/all_to_all have exact transposes), so the same code path serves
+training and inference.
+
+Causal load balance: with contiguous sequence blocks, device i only attends
+ring blocks src <= i, so later devices do more work than earlier ones; the
+fully-masked blocks are skipped via lax.cond (no wasted matmuls), but the
+skew remains — a striped ("zigzag") block-to-device assignment that equalizes
+work per device is the planned follow-up and only changes the position
+bookkeeping here, not the callers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from orion_tpu.ops.attention import NEG_INF, _gqa_expand
+
+BatchAxes = Tuple[str, ...]
+
+
+# ---------------------------------------------------------------------------
+# Blockwise attention with log-sum-exp state (the ring accumulation unit)
+# ---------------------------------------------------------------------------
+
+
+def _block_attend(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_offset: jax.Array,
+    kv_offset: jax.Array,
+    causal: bool,
+    q_segment_ids: Optional[jax.Array],
+    kv_segment_ids: Optional[jax.Array],
+    logit_softcap: Optional[float],
+) -> tuple[jax.Array, jax.Array]:
+    """Attention of local queries against one KV block.
+
+    q: [b, sq, n, h]; k, v: [b, skv, kv, h]. Returns (out [b, sq, n, h] f32,
+    normalized within the block, and lse [b, n, sq] f32, the log-sum-exp of
+    the block's logits; -inf rows mean "nothing attended here").
+    """
+    n_heads, head_dim = q.shape[2], q.shape[3]
+    k = _gqa_expand(k, n_heads)
+    v = _gqa_expand(v, n_heads)
+
+    scale = head_dim ** -0.5
+    logits = jnp.einsum(
+        "bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+
+    mask = None
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]          # [sq, skv]
+        mask = mask[None, None]                           # [1, 1, sq, skv]
+    if q_segment_ids is not None:
+        seg = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    lse = jax.nn.logsumexp(logits, axis=-1)               # [b, n, sq]
+    # Rows with every position masked have lse == NEG_INF-ish; zero them out.
+    dead = lse <= NEG_INF / 2
+    safe_lse = jnp.where(dead, 0.0, lse)
+    probs = jnp.exp(logits - safe_lse[..., None])
+    probs = jnp.where(dead[..., None], 0.0, probs)
+    out = jnp.einsum(
+        "bnqk,bknh->bqnh", probs, v, preferred_element_type=jnp.float32
+    )
+    lse = jnp.where(dead, -jnp.inf, lse)
+    return out, lse
+
+
+def _merge_blocks(
+    o1: jax.Array, l1: jax.Array, o2: jax.Array, l2: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Combine two normalized partial attentions via their log-sum-exps.
+
+    o*: [b, sq, n, h] f32; l*: [b, n, sq] f32 (may be -inf).
+    """
+    m = jnp.maximum(l1, l2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(l1), jnp.exp(l1 - m_safe), 0.0)
+    w2 = jnp.where(jnp.isfinite(l2), jnp.exp(l2 - m_safe), 0.0)
+    denom = w1 + w2
+    lse = jnp.where(denom > 0, m_safe + jnp.log(jnp.maximum(denom, 1e-37)),
+                    -jnp.inf)
+    scale1 = jnp.where(denom > 0, w1 / jnp.maximum(denom, 1e-37), 0.0)
+    scale2 = jnp.where(denom > 0, w2 / jnp.maximum(denom, 1e-37), 0.0)
+    # [b, n, sq] -> [b, sq, n, 1] for broadcasting against [b, sq, n, h].
+    b1 = scale1.transpose(0, 2, 1)[..., None]
+    b2 = scale2.transpose(0, 2, 1)[..., None]
+    return o1 * b1 + o2 * b2, lse
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_seg: Optional[jax.Array],
+    kv_seg: Optional[jax.Array],
+    *,
+    axis: str,
+    causal: bool,
+    logit_softcap: Optional[float],
+    impl: str = "xla",
+) -> jax.Array:
+    """Per-device ring attention body (runs inside shard_map).
+
+    The blockwise unit is the jnp math in _block_attend regardless of
+    ``impl`` for now: the ring merge needs per-block log-sum-exps, which the
+    Pallas flash kernel does not yet expose as an output (tracked for the
+    kernel's residual-returning variant).
+    """
+    sp = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    s_local = q.shape[1]
+    q_off = idx * s_local
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    has_seg = q_seg is not None
+
+    def step(carry, t):
+        k_cur, v_cur, seg_cur, o_acc, l_acc = carry
+        src = jnp.mod(idx - t, sp)
+        kv_off = src * s_local
+
+        def attend(kv):
+            k_c, v_c, seg_c = kv
+            return _block_attend(
+                q, k_c, v_c,
+                q_offset=q_off, kv_offset=kv_off, causal=causal,
+                q_segment_ids=q_seg if has_seg else None,
+                kv_segment_ids=seg_c if has_seg else None,
+                logit_softcap=logit_softcap,
+            )
+
+        if causal:
+            # Blocks entirely in the masked future (src > idx) contribute
+            # nothing; skip their matmuls instead of masking them to -inf.
+            # (The compute skew this leaves across the ring is resolved the
+            # standard way — see the module docstring on striping.)
+            def empty(kv):
+                b, sq, n, h = q.shape
+                return (
+                    jnp.zeros((b, sq, n, h), jnp.float32),
+                    jnp.full((b, n, sq), -jnp.inf, jnp.float32),
+                )
+
+            o_blk, l_blk = lax.cond(
+                src <= idx, attend, empty, (k_cur, v_cur, seg_cur)
+            )
+        else:
+            o_blk, l_blk = attend((k_cur, v_cur, seg_cur))
+        o_acc, l_acc = _merge_blocks(o_acc, l_acc, o_blk, l_blk)
+        # Rotate KV one hop around the sp ring for the next step.
+        k_cur = lax.ppermute(k_cur, axis, perm)
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        if has_seg:
+            seg_cur = lax.ppermute(seg_cur, axis, perm)
+        return (k_cur, v_cur, seg_cur, o_acc, l_acc), None
+
+    b, sq, n = q.shape[0], q.shape[1], q.shape[2]
+    o0 = jnp.zeros((b, sq, n, q.shape[3]), jnp.float32)
+    l0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
+    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    (_, _, _, out, _), _ = lax.scan(
+        step, (k, v, seg0, o0, l0), jnp.arange(sp)
+    )
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_seg: Optional[jax.Array],
+    kv_seg: Optional[jax.Array],
+    *,
+    axis: str,
+    causal: bool,
+    logit_softcap: Optional[float],
+    impl: str = "xla",
+) -> jax.Array:
+    """Per-device Ulysses body: a2a to full-seq / sharded-heads, attend, a2a
+    back (runs inside shard_map). ``impl`` selects the local attention kernel
+    (the Pallas flash kernel under impl='pallas')."""
+    from orion_tpu.ops.attention import attention
+
+    sp = lax.axis_size(axis)
+    # [b, s_loc, n_loc, h] -> [b, S, n_loc/sp, h]
+    qg = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    if q_seg is not None:
+        q_seg = lax.all_gather(q_seg, axis, axis=1, tiled=True)   # [b, S]
+        kv_seg = lax.all_gather(kv_seg, axis, axis=1, tiled=True)
+    out = attention(
+        qg, kg, vg,
+        causal=causal,
+        q_segment_ids=q_seg,
+        kv_segment_ids=kv_seg,
+        logit_softcap=logit_softcap,
+        impl=impl,
+    )
+    # [b, S, n_loc/sp, h] -> [b, s_loc, n_loc, h]
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points (build the shard_map around the local bodies)
+# ---------------------------------------------------------------------------
+
+
+def _specs(axis: str, batch_axes: BatchAxes, head_axis: Optional[str]):
+    qkv = P(batch_axes, axis, head_axis, None)
+    seg = P(batch_axes, axis)
+    return qkv, seg
+
+
+def sequence_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    method: str = "ring",
+    axis: str = "sp",
+    causal: bool = True,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+    batch_axes: BatchAxes = ("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    impl: str = "xla",
+) -> jax.Array:
+    """Sequence-parallel grouped-query causal attention.
+
+    q: [B, S, N, H]; k, v: [B, S, K, H] (global shapes; jit keeps them
+    sequence-sharded on ``axis``). Semantics match ``ops.attention``; the
+    method picks the communication pattern:
+
+      - "ring":    ppermute KV rotation, O(S/sp) comm per step.
+      - "ulysses": head<->sequence all_to_all; requires K % (sp*tp) == 0.
+    """
+    if method not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence method {method!r}")
+    sp = mesh.shape.get(axis, 1)
+    if method == "ulysses":
+        tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+        n_heads, n_kv = q.shape[2], k.shape[2]
+        if n_heads % (sp * tp):
+            raise ValueError(
+                f"ulysses needs n_heads ({n_heads}) divisible by sp*tp "
+                f"({sp}*{tp})"
+            )
+        if n_kv % (sp * tp):
+            # The head<->seq all_to_all moves whole heads; replicate grouped
+            # KV heads up to a divisible count (costs comm volume, like every
+            # Ulysses implementation under GQA).
+            reps = (sp * tp) // n_kv
+            if n_kv * reps != sp * tp or n_heads % (n_kv * reps):
+                raise ValueError(
+                    f"ulysses cannot expand kv_heads ({n_kv}) to a multiple "
+                    f"of sp*tp ({sp}*{tp}) compatible with n_heads {n_heads}"
+                )
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+    if q.shape[1] % sp:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={sp}")
+
+    body = _ring_attention_local if method == "ring" else _ulysses_local
+    fn = partial(
+        body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl
+    )
+    qkv_spec, seg_spec = _specs(axis, batch_axes, head_axis)
+
+    if q_segment_ids is None:
+        mapped = jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_, None, None),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return mapped(q, k, v)
+
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v, q_segment_ids, kv_segment_ids)
+
+
+def ring_attention(q, k, v, mesh, **kw) -> jax.Array:
+    """Ring attention over the ``sp`` axis (see sequence_attention)."""
+    return sequence_attention(q, k, v, mesh, method="ring", **kw)
+
+
+def ulysses_attention(q, k, v, mesh, **kw) -> jax.Array:
+    """Ulysses attention over the ``sp`` axis (see sequence_attention)."""
+    return sequence_attention(q, k, v, mesh, method="ulysses", **kw)

@@ -63,7 +63,9 @@ def state_shardings(cfg: Config, mesh) -> TrainState:
 
 
 def make_train_step(
-    cfg: Config, schedule: Callable[[jax.Array], jax.Array]
+    cfg: Config,
+    schedule: Callable[[jax.Array], jax.Array],
+    mesh: Any = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     mcfg = cfg.model
     accum = cfg.train.grad_accum
@@ -72,7 +74,7 @@ def make_train_step(
         if accum == 1:
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params, batch, mcfg)
+            )(params, batch, mcfg, mesh)
             return loss, aux, grads
 
         # batch leaves are [A, b, S]; scan over microbatches, summing grads.
@@ -80,7 +82,7 @@ def make_train_step(
             acc_grads, acc_loss, acc_aux = carry
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params, mb, mcfg)
+            )(params, mb, mcfg, mesh)
             acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
             acc_loss = acc_loss + loss
             acc_aux = jax.tree.map(jnp.add, acc_aux, aux)
@@ -89,7 +91,7 @@ def make_train_step(
         zero_grads = jax.tree.map(jnp.zeros_like, params)
         micro0 = jax.tree.map(lambda v: v[0], batch)
         aux_shapes = jax.eval_shape(
-            lambda p, b: loss_fn(p, b, mcfg)[1], params, micro0
+            lambda p, b: loss_fn(p, b, mcfg, mesh)[1], params, micro0
         )
         zero_aux = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes
@@ -138,12 +140,38 @@ class Trainer:
     """
 
     def __init__(self, cfg: Config):
+        if cfg.parallel.sp > 1:
+            # Route attention through ring/Ulysses over the sp axis
+            # (parallel.sequence); all other layers are pointwise over the
+            # sequence and stay sequence-sharded via the "seq" rule.
+            import dataclasses as _dc
+
+            if cfg.data.seq_len % cfg.parallel.sp:
+                raise ValueError(
+                    f"data.seq_len={cfg.data.seq_len} must be divisible by "
+                    f"parallel.sp={cfg.parallel.sp}"
+                )
+            if cfg.parallel.sequence_method == "ulysses":
+                sp_tp = cfg.parallel.sp * cfg.parallel.tp
+                if cfg.model.n_heads % sp_tp:
+                    raise ValueError(
+                        f"ulysses needs model.n_heads={cfg.model.n_heads} "
+                        f"divisible by sp*tp={sp_tp}"
+                    )
+            cfg = _dc.replace(
+                cfg,
+                model=_dc.replace(
+                    cfg.model,
+                    sequence_axis="sp",
+                    sequence_method=cfg.parallel.sequence_method,
+                ),
+            )
         self.cfg = cfg
-        if cfg.parallel.pp > 1 or cfg.parallel.sp > 1:
-            # Landed by parallel.pipeline / parallel.ring+ulysses integration;
-            # fail loudly rather than silently replicating work.
+        if cfg.parallel.pp > 1:
+            # Landed by parallel.pipeline integration; fail loudly rather
+            # than silently replicating work.
             raise NotImplementedError(
-                "pp/sp mesh axes are not wired into the dense trainer yet"
+                "the pp mesh axis is not wired into the dense trainer yet"
             )
         if cfg.data.batch_size % max(cfg.train.grad_accum, 1):
             raise ValueError(
@@ -157,7 +185,7 @@ class Trainer:
         self.loader = make_loader(cfg.data, cfg.model.vocab_size)
         schedule = make_schedule(cfg.optimizer, cfg.train.num_steps)
         self.train_step = jax.jit(
-            make_train_step(cfg, schedule), donate_argnums=(0,)
+            make_train_step(self.cfg, schedule, self.mesh), donate_argnums=(0,)
         )
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint.directory:
