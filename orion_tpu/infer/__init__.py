@@ -1,0 +1,20 @@
+"""Inference runtime: paged KV cache, prefill/decode, continuous batching.
+
+TPU-native equivalent of the reference's ``inference/generate.py`` with
+continuous batching (BASELINE.json:11; SURVEY.md §4 stack B): a fixed-size
+paged KV-cache pool keeps every device shape static for XLA, prefill and
+decode are separate jit programs, and a host-side admission/scheduler loop
+streams requests in and tokens out.
+"""
+
+from orion_tpu.infer.engine import InferenceEngine, Request
+from orion_tpu.infer.kv_cache import PageAllocator, init_cache
+from orion_tpu.infer.sampling import sample
+
+__all__ = [
+    "InferenceEngine",
+    "Request",
+    "PageAllocator",
+    "init_cache",
+    "sample",
+]

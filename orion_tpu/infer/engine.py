@@ -1,0 +1,304 @@
+"""Continuous-batching inference engine (host-side scheduler).
+
+Mirrors the reference's ``inference/generate.py`` serving loop
+(BASELINE.json:11; SURVEY.md §4 stack B): an admission/scheduler loop on the
+host drives two jit programs — per-prompt prefill (bucketed static lengths)
+and whole-batch decode (fully static shapes). Requests join mid-flight as
+slots and KV pages free up; batching never changes any request's tokens
+(checked by the equivalence tests in tests/test_infer.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import Config
+from orion_tpu.infer.kv_cache import PageAllocator, init_cache, pages_per_seq
+from orion_tpu.infer.runner import decode_step, prefill_step
+from orion_tpu.infer.sampling import sample
+
+log = logging.getLogger("orion_tpu.infer")
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    generated: list[int] = field(default_factory=list)
+    # scheduler state
+    slot: Optional[int] = None
+    pages: list[int] = field(default_factory=list)
+    done: bool = False
+    admit_seq: int = -1   # admission order; preemption evicts the youngest
+
+    @property
+    def context(self) -> list[int]:
+        """Tokens whose KV must be in cache: prompt + everything generated.
+        This is what (re-)prefill runs on, so a preempted request resumes
+        exactly where it left off."""
+        return self.prompt + self.generated
+
+    @property
+    def active(self) -> bool:
+        return self.slot is not None and not self.done
+
+
+class InferenceEngine:
+    """Paged-KV continuous-batching engine over a single model replica.
+
+    Multi-chip serving shards the same programs over a mesh (the params'
+    shardings decide); the scheduler below is mesh-agnostic.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        *,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.mcfg = cfg.model
+        self.icfg = cfg.inference
+        self.params = params
+        self.eos_id = eos_id
+        self.psz = self.icfg.page_size
+        self.pages_per_seq = pages_per_seq(self.icfg)
+        self.max_batch = self.icfg.max_batch_size
+        if self.icfg.prefill_chunk % self.psz:
+            raise ValueError(
+                f"prefill_chunk={self.icfg.prefill_chunk} must be a "
+                f"multiple of page_size={self.psz}"
+            )
+
+        self.cache = init_cache(self.mcfg, self.icfg)
+        self.alloc = PageAllocator(self.icfg.num_pages)
+        self.page_table = np.zeros(
+            (self.max_batch, self.pages_per_seq), np.int32
+        )
+        self.seq_lens = np.zeros(self.max_batch, np.int32)
+        self.last_token = np.zeros(self.max_batch, np.int32)
+        self.slots: list[Optional[Request]] = [None] * self.max_batch
+        self.waiting: deque[Request] = deque()
+        self._just_finished: list[Request] = []
+        self._rid = itertools.count()
+        self._admit_seq = itertools.count()
+        self._key = jax.random.key(seed)
+        self.preemptions = 0
+
+        self._decode = jax.jit(
+            partial(decode_step, cfg=self.mcfg), donate_argnums=(1,)
+        )
+        # One prefill specialization per padded bucket length (S_pad is a
+        # static shape; the jit cache keys on it automatically).
+        self._prefill = jax.jit(
+            partial(prefill_step, cfg=self.mcfg), donate_argnums=(1,)
+        )
+
+    # -- public API --------------------------------------------------------
+
+    def submit(
+        self, prompt: Sequence[int], max_new_tokens: Optional[int] = None
+    ) -> int:
+        if not len(prompt):
+            raise ValueError("empty prompt")
+        limit = self.icfg.max_seq_len
+        if len(prompt) >= limit:
+            raise ValueError(f"prompt length {len(prompt)} >= max_seq_len {limit}")
+        needed = self._bucket_len(len(prompt)) // self.psz + 1
+        usable = self.icfg.num_pages - 1
+        if needed > usable:
+            raise ValueError(
+                f"prompt needs {needed} KV pages but the pool only has "
+                f"{usable}; raise inference.num_pages"
+            )
+        req = Request(
+            rid=next(self._rid),
+            prompt=list(map(int, prompt)),
+            max_new_tokens=(
+                max_new_tokens
+                if max_new_tokens is not None
+                else self.icfg.max_new_tokens
+            ),
+        )
+        self.waiting.append(req)
+        return req.rid
+
+    def step(self) -> list[Request]:
+        """Admit + prefill new requests, decode one token for all active
+        slots; returns the requests that finished this step."""
+        self._admit()
+        self._decode_all()
+        done, self._just_finished = self._just_finished, []
+        return done
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            r is not None and not r.done for r in self.slots
+        )
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ) -> list[list[int]]:
+        """Convenience drain loop: returns generated tokens per prompt, in
+        submission order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        results: dict[int, list[int]] = {}
+        while self.has_work():
+            for req in self.step():
+                results[req.rid] = req.generated
+        return [results[rid] for rid in rids]
+
+    # -- scheduler internals ----------------------------------------------
+
+    def _bucket_len(self, n: int) -> int:
+        chunk = self.icfg.prefill_chunk
+        return min(-(-n // chunk) * chunk, self.icfg.max_seq_len)
+
+    def _admit(self) -> None:
+        while self.waiting:
+            req = self.waiting[0]
+            slot = next(
+                (i for i, r in enumerate(self.slots) if r is None), None
+            )
+            if slot is None:
+                return
+            context = req.context
+            s_pad = self._bucket_len(len(context))
+            n_pages = s_pad // self.psz
+            if self.alloc.free_pages < n_pages + 1:
+                return  # head-of-line blocking: keep arrival order
+            self.waiting.popleft()
+            req.slot = slot
+            req.admit_seq = next(self._admit_seq)
+            req.pages = self.alloc.alloc(n_pages)
+            self.slots[slot] = req
+
+            tokens = np.zeros((1, s_pad), np.int32)
+            tokens[0, : len(context)] = context
+            logits, self.cache = self._prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.int32(len(context)),
+                jnp.asarray(np.asarray(req.pages, np.int32)),
+            )
+            self.page_table[slot, :n_pages] = req.pages
+            self.seq_lens[slot] = len(context)
+            if req.max_new_tokens <= 0:
+                req.done = True   # prefill-only (scoring) request
+                continue
+            first = self._sample(logits[None, :])[0]
+            self.last_token[slot] = first
+            req.generated.append(int(first))
+            self._maybe_finish(req, int(first))
+
+    def _preempt(self, req: Request) -> None:
+        """Evict an active request, returning its pages; it re-enters at the
+        head of the queue and resumes from its full context on re-prefill."""
+        log.info("preempting request %d (pool pressure)", req.rid)
+        self.preemptions += 1
+        slot = req.slot
+        self.alloc.free(req.pages)
+        req.pages = []
+        req.slot = None
+        self.slots[slot] = None
+        self.page_table[slot] = 0
+        self.seq_lens[slot] = 0
+        self.last_token[slot] = 0
+        self.waiting.appendleft(req)
+
+    def _grow_pages(self) -> None:
+        """Allocate a fresh page for every slot whose next token starts a new
+        page, preempting the youngest-admitted request under pool pressure
+        (oldest requests keep making progress; no mid-decode crash)."""
+        by_age = sorted(
+            (r for r in self.slots if r is not None and not r.done),
+            key=lambda r: r.admit_seq,
+        )
+        for req in by_age:
+            if req.slot is None:
+                continue  # preempted earlier in this pass
+            pos = int(self.seq_lens[req.slot])
+            if pos % self.psz or pos // self.psz < len(req.pages):
+                continue
+            while self.alloc.free_pages < 1:
+                victims = [
+                    r for r in by_age
+                    if r.slot is not None and r is not req
+                ]
+                if not victims:
+                    raise MemoryError(
+                        "KV pool too small for a single request; raise "
+                        "inference.num_pages"
+                    )
+                self._preempt(victims[-1])
+            page = self.alloc.alloc(1)[0]
+            self.page_table[req.slot, len(req.pages)] = page
+            req.pages.append(page)
+
+    def _decode_all(self) -> None:
+        self._grow_pages()
+        active = [r for r in self.slots if r is not None and not r.done]
+        if not active:
+            self._reap()
+            return
+        logits, self.cache = self._decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self.last_token[:, None]),
+            jnp.asarray(self.seq_lens),
+            jnp.asarray(self.page_table),
+        )
+        tokens = self._sample(logits)
+        for req in active:
+            tok = int(tokens[req.slot])
+            self.seq_lens[req.slot] += 1
+            self.last_token[req.slot] = tok
+            req.generated.append(tok)
+            self._maybe_finish(req, tok)
+        self._reap()
+
+    def _sample(self, logits: jax.Array) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        toks = sample(
+            logits,
+            sub,
+            temperature=self.icfg.temperature,
+            top_k=self.icfg.top_k,
+            top_p=self.icfg.top_p,
+        )
+        return np.asarray(jax.device_get(toks))
+
+    def _maybe_finish(self, req: Request, tok: int) -> None:
+        hit_eos = self.eos_id is not None and tok == self.eos_id
+        # seq_lens counts tokens whose KV is cached; the just-sampled token
+        # is not yet written, and its write position (== seq_lens) must stay
+        # inside the context window.
+        ctx_full = int(self.seq_lens[req.slot]) >= self.icfg.max_seq_len
+        if hit_eos or ctx_full or len(req.generated) >= req.max_new_tokens:
+            req.done = True
+
+    def _reap(self) -> None:
+        for i, req in enumerate(self.slots):
+            if req is not None and req.done:
+                self.alloc.free(req.pages)
+                req.pages = []
+                self.slots[i] = None
+                self.page_table[i] = 0
+                self.seq_lens[i] = 0
+                self.last_token[i] = 0
+                self._just_finished.append(req)

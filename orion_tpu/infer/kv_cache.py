@@ -1,0 +1,87 @@
+"""Paged KV-cache pool + host-side page allocator.
+
+The reference's continuous-batching server manages a paged KV cache
+(BASELINE.json:11; PAPERS.md:9 "ragged paged attention for TPU"). TPU-native
+design: one global pool of fixed-size pages per layer, so every jit program
+sees static shapes; sequences own pages through an integer page table, and
+the *allocator* — the only dynamic piece — lives on the host, where it is a
+free list, not a device computation.
+
+Layout:
+    k_pool, v_pool: [L, num_pages, page_size, n_kv_heads, head_dim]
+    page_table:     [max_batch, pages_per_seq] int32 (host, shipped per step)
+    seq_lens:       [max_batch] int32            (host, shipped per step)
+
+Page 0 is reserved as a scratch page: every inactive batch slot points at it,
+so device-side gathers/scatters are always in-bounds and slot masking is done
+with seq_lens alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import InferenceConfig, ModelConfig
+
+Cache = dict[str, jax.Array]
+
+
+def pages_per_seq(icfg: InferenceConfig) -> int:
+    assert icfg.max_seq_len % icfg.page_size == 0, (
+        icfg.max_seq_len, icfg.page_size)
+    return icfg.max_seq_len // icfg.page_size
+
+
+def init_cache(
+    mcfg: ModelConfig,
+    icfg: InferenceConfig,
+    device: Optional[jax.Device] = None,
+) -> Cache:
+    """Allocate the paged KV pool (zeros)."""
+    shape = (
+        mcfg.n_layers,
+        icfg.num_pages,
+        icfg.page_size,
+        mcfg.n_kv_heads,
+        mcfg.resolved_head_dim,
+    )
+    dtype = jnp.dtype(mcfg.dtype)
+
+    def alloc():
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    if device is not None:
+        with jax.default_device(device):
+            return alloc()
+    return alloc()
+
+
+class PageAllocator:
+    """Host-side free list over the page pool (page 0 reserved as scratch)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is scratch)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV cache pool exhausted: want {n} pages, have "
+                f"{len(self._free)}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            assert 0 < p < self.num_pages, p
+            self._free.append(p)

@@ -1,0 +1,146 @@
+"""Cache-aware forward passes: prefill and decode over the paged KV pool.
+
+The reference serves generation through prefill/decode phases over a KV
+cache (BASELINE.json:11; SURVEY.md §4 stack B). TPU-native shape discipline:
+
+  - ``prefill_step`` processes one prompt padded to a static bucket length
+    (one jit specialization per bucket), runs ordinary causal (flash)
+    attention, and scatters the computed K/V pages into the pool.
+  - ``decode_step`` advances ALL batch slots one token in a single program of
+    fully static shape: scatter the new token's K/V into each sequence's
+    current page, gather each sequence's pages, and attend under a
+    length mask. Inactive slots point at the reserved scratch page 0 and are
+    masked by seq_len only — no dynamic batch shapes anywhere.
+
+Model math is shared with training via models.transformer.qkv_proj /
+out_proj / mlp_or_moe — the cache runner only changes what attention reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+from orion_tpu.models.transformer import (
+    Params,
+    _norm,
+    embed,
+    mlp_or_moe,
+    out_proj,
+    qkv_proj,
+    unembed,
+)
+from orion_tpu.ops import attention
+from orion_tpu.ops.attention import attention_xla
+
+Cache = dict[str, jax.Array]
+
+
+def _layer_iter(params: Params, cache: Cache, cfg: ModelConfig, body):
+    """Run ``body(x, bp, k_pool_l, v_pool_l) -> (x, k_pool_l, v_pool_l)``
+    over all layers, scanning when the params are stacked."""
+
+    def scan_body(x, xs):
+        bp, kl, vl = xs
+        x, kl, vl = body(x, bp, kl, vl)
+        return x, (kl, vl)
+
+    def run(x):
+        if cfg.scan_layers:
+            x, (new_k, new_v) = jax.lax.scan(
+                scan_body, x, (params["blocks"], cache["k"], cache["v"])
+            )
+        else:
+            ks, vs = [], []
+            for i, bp in enumerate(params["blocks"]):
+                x, kl, vl = body(x, bp, cache["k"][i], cache["v"][i])
+                ks.append(kl)
+                vs.append(vl)
+            new_k, new_v = jnp.stack(ks), jnp.stack(vs)
+        return x, {"k": new_k, "v": new_v}
+
+    return run
+
+
+def prefill_step(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [1, S_pad]  (padded prompt)
+    length: jax.Array,        # scalar int32: true prompt length
+    pages: jax.Array,         # [S_pad // page_size] int32 page ids
+    cfg: ModelConfig,
+) -> tuple[jax.Array, Cache]:
+    """Prefill one prompt; returns (next-token logits [V], updated cache)."""
+    S_pad = tokens.shape[1]
+    psz = cache["k"].shape[2]
+    n_pages = S_pad // psz
+    positions = jnp.broadcast_to(
+        jnp.arange(S_pad, dtype=jnp.int32), (1, S_pad)
+    )
+
+    def body(x, bp, kl, vl):
+        h = _norm(x, bp["attn_norm"], cfg)
+        q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
+        out = attention(q, k, v, causal=True, impl=cfg.kernels)
+        x = x + out_proj(out, bp["attn"], cfg)
+        h2 = _norm(x, bp["mlp_norm"], cfg)
+        y, _ = mlp_or_moe(h2, bp, cfg)
+        x = x + y
+        # Scatter this layer's K/V pages into the pool. Positions beyond
+        # `length` hold garbage from the padding — decode masks them out
+        # via seq_lens, and the next real token overwrites its slot.
+        K, H = k.shape[2], k.shape[3]
+        kl = kl.at[pages].set(k[0].reshape(n_pages, psz, K, H))
+        vl = vl.at[pages].set(v[0].reshape(n_pages, psz, K, H))
+        return x, kl, vl
+
+    x = embed(params, tokens, positions, cfg)
+    x, new_cache = _layer_iter(params, cache, cfg, body)(x)
+    logits = unembed(params, x, cfg)          # [1, S_pad, V]
+    return logits[0, length - 1], new_cache
+
+
+def decode_step(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B, 1]  newest token per slot
+    seq_lens: jax.Array,      # [B] int32: tokens already in cache per slot
+    page_table: jax.Array,    # [B, pages_per_seq] int32
+    cfg: ModelConfig,
+) -> tuple[jax.Array, Cache]:
+    """One decode step for every slot; returns (logits [B, V], cache)."""
+    B = tokens.shape[0]
+    psz = cache["k"].shape[2]
+    P = page_table.shape[1]
+    positions = seq_lens[:, None]              # new token's position [B, 1]
+    batch_idx = jnp.arange(B)
+
+    page_idx = page_table[batch_idx, seq_lens // psz]   # [B]
+    offset = seq_lens % psz                              # [B]
+    # KV positions valid after the write: arange <= seq_len.
+    kv_mask = (
+        jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+        <= seq_lens[:, None, None]
+    )                                                    # [B, 1, P*psz]
+
+    def body(x, bp, kl, vl):
+        h = _norm(x, bp["attn_norm"], cfg)
+        q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
+        K, H = k.shape[2], k.shape[3]
+        kl = kl.at[page_idx, offset].set(k[:, 0])
+        vl = vl.at[page_idx, offset].set(v[:, 0])
+        k_ctx = kl[page_table].reshape(B, P * psz, K, H)
+        v_ctx = vl[page_table].reshape(B, P * psz, K, H)
+        out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
+        x = x + out_proj(out, bp["attn"], cfg)
+        h2 = _norm(x, bp["mlp_norm"], cfg)
+        y, _ = mlp_or_moe(h2, bp, cfg)
+        return x + y, kl, vl
+
+    x = embed(params, tokens, positions, cfg)
+    x, new_cache = _layer_iter(params, cache, cfg, body)(x)
+    logits = unembed(params, x, cfg)          # [B, 1, V]
+    return logits[:, 0], new_cache

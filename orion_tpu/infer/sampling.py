@@ -1,0 +1,54 @@
+"""Token sampling: greedy / temperature / top-k / nucleus (top-p).
+
+Static-shape TPU formulation: top-k and top-p are masks over the full vocab
+(sort + cumulative sum), never a dynamic-length candidate list.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """logits: [B, V] -> sampled token ids [B] int32.
+
+    temperature <= 0 means greedy argmax (the deterministic mode the
+    batching-equivalence tests rely on). top_k=0 / top_p=1.0 disable the
+    respective filters.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32) / temperature
+
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass >= top_p (always
+        # keep the argmax itself).
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1
+        )
+        # Threshold = smallest kept logit per row.
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

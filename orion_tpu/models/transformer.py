@@ -180,14 +180,37 @@ def _norm(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     return ops.layernorm(x, p["scale"], p.get("bias"), eps=cfg.norm_eps)
 
 
-def _attn_block(
-    x: jax.Array,
-    p: Params,
-    cfg: ModelConfig,
-    positions: jax.Array,
-    segment_ids: Optional[jax.Array],
-    mesh: Optional[Any] = None,
+def embed(
+    params: Params, tokens: jax.Array, positions: jax.Array, cfg: ModelConfig
 ) -> jax.Array:
+    """Token (+ learned position) embedding; shared by training forward and
+    the inference cache runner."""
+    x = params["embed"]["tokens"].astype(jnp.dtype(cfg.dtype))[tokens]
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["positions"].astype(x.dtype)[positions]
+    return x
+
+
+def unembed(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Final norm + LM head -> float32 logits; shared like ``embed``."""
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"]["tokens"].astype(x.dtype)
+        )
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32)
+
+
+def qkv_proj(
+    x: jax.Array, p: Params, cfg: ModelConfig, positions: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """QKV projection + RoPE. x: [B, S, D] -> q [B,S,N,H], k/v [B,S,K,H].
+
+    Shared between the training forward and the inference cache runner
+    (orion_tpu.infer.runner), which attends against different KV sources.
+    """
     B, S, _ = x.shape
     N, K, H = cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim
     dtype = x.dtype
@@ -206,6 +229,43 @@ def _attn_block(
     if cfg.pos_embedding == "rope":
         q = ops.apply_rope(q, positions, theta=cfg.rope_theta, impl=cfg.kernels)
         k = ops.apply_rope(k, positions, theta=cfg.rope_theta, impl=cfg.kernels)
+    return q, k, v
+
+
+def out_proj(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Attention output projection. out: [B, S, N, H] -> [B, S, D]."""
+    B, S = out.shape[0], out.shape[1]
+    dtype = out.dtype
+    y = jnp.einsum(
+        "bsh,hd->bsd", out.reshape(B, S, -1), p["wo"].astype(dtype)
+    )
+    if cfg.attn_bias:
+        y = y + p["bo"].astype(dtype)
+    return y
+
+
+def mlp_or_moe(
+    h: jax.Array, bp: Params, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """The post-attention half of a block: dense MLP or MoE. Returns (y, aux)."""
+    if cfg.is_moe:
+        moe_params = {
+            k: v.astype(h.dtype) if k != "router" else v
+            for k, v in bp["moe"].items()
+        }
+        return moe_lib.moe_mlp(h, moe_params, cfg)
+    return _mlp_block(h, bp["mlp"], cfg), jnp.zeros((), jnp.float32)
+
+
+def _attn_block(
+    x: jax.Array,
+    p: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+    mesh: Optional[Any] = None,
+) -> jax.Array:
+    q, k, v = qkv_proj(x, p, cfg, positions)
 
     sp_active = (
         cfg.sequence_axis is not None
@@ -239,11 +299,7 @@ def _attn_block(
             logit_softcap=cfg.attn_logit_softcap,
             impl=cfg.kernels,
         )
-    out = out.reshape(B, S, N * H)
-    y = jnp.einsum("bsh,hd->bsd", out, p["wo"].astype(dtype))
-    if cfg.attn_bias:
-        y = y + p["bo"].astype(dtype)
-    return y
+    return out_proj(out, p, cfg)
 
 
 def _mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
@@ -274,15 +330,7 @@ def _block(
     x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
                         positions, segment_ids, mesh)
     h = _norm(x, bp["mlp_norm"], cfg)
-    if cfg.is_moe:
-        moe_params = {
-            k: v.astype(x.dtype) if k != "router" else v
-            for k, v in bp["moe"].items()
-        }
-        y, aux = moe_lib.moe_mlp(h, moe_params, cfg)
-    else:
-        y = _mlp_block(h, bp["mlp"], cfg)
-        aux = jnp.zeros((), jnp.float32)
+    y, aux = mlp_or_moe(h, bp, cfg)
     return x + y, aux
 
 
@@ -296,14 +344,11 @@ def forward(
     mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """tokens: [B, S] int32 -> (logits [B, S, V] float32, moe_aux scalar)."""
-    dtype = jnp.dtype(cfg.dtype)
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
-    x = params["embed"]["tokens"].astype(dtype)[tokens]
-    if cfg.pos_embedding == "learned":
-        x = x + params["embed"]["positions"].astype(dtype)[positions]
+    x = embed(params, tokens, positions, cfg)
 
     def block_fn(carry, bp):
         y, aux = _block(carry, bp, cfg, positions, segment_ids, mesh)
@@ -326,14 +371,7 @@ def forward(
             x, aux = block_fn(x, bp)
             moe_aux = moe_aux + aux
 
-    x = _norm(x, params["final_norm"], cfg)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"]["tokens"].astype(dtype)
-        )
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dtype))
-    return logits.astype(jnp.float32), moe_aux
+    return unembed(params, x, cfg), moe_aux
 
 
 def loss_fn(

@@ -155,54 +155,56 @@ def _ring_attention_local(
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     has_seg = q_seg is not None
 
-    def step(carry, t):
-        k_cur, v_cur, seg_cur, o_acc, l_acc = carry
-        src = jnp.mod(idx - t, sp)
+    def attend(k_c, v_c, seg_c, src):
         kv_off = src * s_local
 
-        def attend(kv):
-            k_c, v_c, seg_c = kv
+        def compute(kv):
+            k_, v_, seg_ = kv
             return _block_attend(
-                q, k_c, v_c,
+                q, k_, v_,
                 q_offset=q_off, kv_offset=kv_off, causal=causal,
                 q_segment_ids=q_seg if has_seg else None,
-                kv_segment_ids=seg_c if has_seg else None,
+                kv_segment_ids=seg_ if has_seg else None,
                 logit_softcap=logit_softcap,
             )
 
-        if causal:
-            # Blocks entirely in the masked future (src > idx) contribute
-            # nothing; skip their matmuls instead of masking them to -inf.
-            # (The compute skew this leaves across the ring is resolved the
-            # standard way — see the module docstring on striping.)
-            def empty(kv):
-                b, sq, n, h = q.shape
-                return (
-                    jnp.zeros((b, sq, n, h), jnp.float32),
-                    jnp.full((b, n, sq), -jnp.inf, jnp.float32),
-                )
+        if not causal:
+            return compute((k_c, v_c, seg_c))
 
-            o_blk, l_blk = lax.cond(
-                src <= idx, attend, empty, (k_cur, v_cur, seg_cur)
+        # Blocks entirely in the masked future (src > idx) contribute
+        # nothing; skip their matmuls instead of masking them to -inf.
+        # (The compute skew this leaves across the ring is resolved the
+        # standard way — see the module docstring on striping.)
+        def empty(kv):
+            b, sq, n, h = q.shape
+            return (
+                jnp.zeros((b, sq, n, h), jnp.float32),
+                jnp.full((b, n, sq), -jnp.inf, jnp.float32),
             )
-        else:
-            o_blk, l_blk = attend((k_cur, v_cur, seg_cur))
-        o_acc, l_acc = _merge_blocks(o_acc, l_acc, o_blk, l_blk)
-        # Rotate KV one hop around the sp ring for the next step.
+
+        return lax.cond(src <= idx, compute, empty, (k_c, v_c, seg_c))
+
+    # Step 0 attends the local KV block; the scan then does exactly sp-1
+    # rotate->attend steps (no trailing rotation whose result is discarded).
+    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    o_acc, l_acc = attend(k, v, seg0, idx)
+
+    def step(carry, t):
+        k_cur, v_cur, seg_cur, o, l = carry
         k_cur = lax.ppermute(k_cur, axis, perm)
         v_cur = lax.ppermute(v_cur, axis, perm)
         if has_seg:
             seg_cur = lax.ppermute(seg_cur, axis, perm)
-        return (k_cur, v_cur, seg_cur, o_acc, l_acc), None
+        src = jnp.mod(idx - t, sp)
+        o_blk, l_blk = attend(k_cur, v_cur, seg_cur, src)
+        o, l = _merge_blocks(o, l, o_blk, l_blk)
+        return (k_cur, v_cur, seg_cur, o, l), None
 
-    b, sq, n = q.shape[0], q.shape[1], q.shape[2]
-    o0 = jnp.zeros((b, sq, n, q.shape[3]), jnp.float32)
-    l0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
-    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
-    (_, _, _, out, _), _ = lax.scan(
-        step, (k, v, seg0, o0, l0), jnp.arange(sp)
-    )
-    return out.astype(q.dtype)
+    if sp > 1:
+        (_, _, _, o_acc, _), _ = lax.scan(
+            step, (k, v, seg0, o_acc, l_acc), jnp.arange(1, sp)
+        )
+    return o_acc.astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

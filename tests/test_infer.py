@@ -1,0 +1,174 @@
+"""Inference-tier tests (SURVEY.md §5): the continuous-batching engine fed
+request mixes must produce exactly the tokens of single-request generation,
+and the paged KV cache must recycle pages."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import get_config
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.infer.sampling import sample
+from orion_tpu.models import forward, init_params
+
+INFER_OVERRIDES = [
+    "inference.max_seq_len=128",
+    "inference.page_size=16",
+    "inference.num_pages=32",
+    "inference.max_batch_size=4",
+    "inference.prefill_chunk=16",
+    "inference.max_new_tokens=8",
+]
+
+
+def _setup(preset="tiny-llama", overrides=()):
+    cfg = get_config(preset, INFER_OVERRIDES + list(overrides))
+    params = init_params(cfg.model, jax.random.key(0))
+    return cfg, params
+
+
+def _ref_generate(params, mcfg, prompt, n):
+    """Autoregressive greedy generation via the full training forward."""
+    toks = list(prompt)
+    for _ in range(n):
+        logits, _ = forward(params, jnp.asarray([toks], jnp.int32), mcfg)
+        toks.append(int(jnp.argmax(logits[0, len(toks) - 1])))
+    return toks[len(prompt):]
+
+
+@pytest.mark.parametrize("preset", ["tiny-llama", "tiny", "tiny-mixtral"])
+def test_engine_matches_full_forward(preset):
+    """Paged-cache decode must reproduce the no-cache forward exactly
+    (greedy), across the model zoo: RoPE/GQA, learned-pos/LayerNorm, MoE."""
+    cfg, params = _setup(preset)
+    prompt = [5, 3, 9, 250, 17]
+    ref = _ref_generate(params, cfg.model, prompt, 8)
+    out = InferenceEngine(cfg, params).generate([prompt], 8)[0]
+    assert out == ref
+
+
+def test_continuous_batching_preserves_outputs():
+    """Batched serving (with queueing beyond max_batch_size) must not change
+    any request's tokens."""
+    cfg, params = _setup()
+    prompts = [
+        [5, 3, 9],
+        [250, 17, 4, 8, 100, 42],
+        [7] * 20,
+        [1, 2],
+        [99, 98, 97, 96],
+        [11, 13, 17, 19, 23],
+    ]  # 6 requests > max_batch_size=4 forces admission queueing
+    singles = [
+        InferenceEngine(cfg, params).generate([p], 6)[0] for p in prompts
+    ]
+    batched = InferenceEngine(cfg, params).generate(prompts, 6)
+    assert batched == singles
+
+
+def test_mid_flight_admission():
+    """A request submitted while another is decoding joins the batch without
+    disturbing either result."""
+    cfg, params = _setup()
+    p1, p2 = [5, 3, 9, 250, 17], [42, 7]
+    ref1 = InferenceEngine(cfg, params).generate([p1], 8)[0]
+    ref2 = InferenceEngine(cfg, params).generate([p2], 8)[0]
+
+    eng = InferenceEngine(cfg, params)
+    eng.submit(p1, 8)
+    finished = []
+    finished += eng.step()
+    finished += eng.step()
+    eng.submit(p2, 8)
+    while eng.has_work():
+        finished += eng.step()
+    by_rid = sorted(finished, key=lambda r: r.rid)
+    assert [r.generated for r in by_rid] == [ref1, ref2]
+
+
+def test_eos_stops_generation():
+    cfg, params = _setup()
+    prompt = [5, 3, 9]
+    free_run = InferenceEngine(cfg, params).generate([prompt], 8)[0]
+    eos = free_run[2]  # treat the 3rd generated token as EOS
+    out = InferenceEngine(cfg, params, eos_id=eos).generate([prompt], 8)[0]
+    assert out == free_run[:3]
+
+
+def test_pages_recycled_and_pool_exhaustion_queues():
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    eng.generate([[7] * 20, [1, 2, 3], [4, 5]], 6)
+    assert eng.alloc.free_pages == cfg.inference.num_pages - 1  # page 0 scratch
+
+    # A prompt longer than the context window is rejected at submit.
+    with pytest.raises(ValueError):
+        eng.submit([1] * 200, 4)
+
+
+def test_oversized_prompt_rejected_at_submit():
+    """A prompt whose pages can never fit the pool raises instead of
+    queueing forever."""
+    cfg, params = _setup(overrides=["inference.num_pages=4"])
+    eng = InferenceEngine(cfg, params)
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit([1] * 40, 4)
+
+
+def test_preemption_under_pool_pressure():
+    """When concurrent decodes exhaust the page pool, the youngest request
+    is preempted, re-prefilled from its context later, and still produces
+    exactly the single-request tokens."""
+    cfg, params = _setup(overrides=["inference.num_pages=8"])
+    # 7 usable pages of 16 tokens; two requests decoding from 15-token
+    # prompts out to 15+50=65 tokens each want 5 pages apiece at the end —
+    # more than the pool — so at least one preemption must happen.
+    prompts = [[5, 3, 9, 250, 17, 8, 100, 42, 77, 31, 2, 6, 90, 55, 21],
+               [7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53, 59, 61]]
+    singles = [
+        InferenceEngine(cfg, params).generate([p], 50)[0] for p in prompts
+    ]
+    eng = InferenceEngine(cfg, params)
+    batched = eng.generate(prompts, 50)
+    assert eng.preemptions > 0, "scenario failed to exercise preemption"
+    assert batched == singles
+
+
+def test_max_new_tokens_zero_is_prefill_only():
+    cfg, params = _setup()
+    assert InferenceEngine(cfg, params).generate([[1, 2, 3]], 0) == [[]]
+
+
+def test_long_generation_allocates_pages_on_demand():
+    """Crossing page boundaries mid-decode allocates new pages and keeps
+    matching the reference."""
+    cfg, params = _setup()
+    prompt = [5, 3, 9, 250, 17, 8, 100, 42, 77, 31, 2, 6, 90, 55, 21]  # 15
+    n = 20  # crosses the 16-token page boundary twice
+    ref = _ref_generate(params, cfg.model, prompt, n)
+    out = InferenceEngine(cfg, params).generate([prompt], n)[0]
+    assert out == ref
+
+
+# -- sampling ---------------------------------------------------------------
+
+
+def test_sample_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 3.0, -1.0], [2.0, 0.0, 5.0]])
+    toks = sample(logits, jax.random.key(0), temperature=0.0)
+    assert toks.tolist() == [1, 2]
+
+
+def test_sample_top_k_restricts_support():
+    logits = jnp.asarray([[5.0, 4.0, -10.0, -10.0]])
+    for s in range(20):
+        t = sample(logits, jax.random.key(s), temperature=1.0, top_k=2)
+        assert int(t[0]) in (0, 1)
+
+
+def test_sample_top_p_restricts_support():
+    logits = jnp.asarray([[10.0, 9.0, -10.0, -10.0]])
+    for s in range(20):
+        t = sample(logits, jax.random.key(s), temperature=1.0, top_p=0.9)
+        assert int(t[0]) in (0, 1)

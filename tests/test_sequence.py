@@ -43,6 +43,16 @@ def test_sp_gqa(cpu_devices, method):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_ulysses_gqa_kv_replication(cpu_devices):
+    """GQA with kv_heads < sp exercises the KV head-replication branch
+    (kv_heads=2 replicated to sp=4) and must stay exact."""
+    mesh = make_mesh(cpu_devices, sp=4)
+    q, k, v = _qkv(jax.random.key(9), n=8, k_heads=2)
+    ref = attention_xla(q, k, v, causal=True)
+    out = sequence_attention(q, k, v, mesh, method="ulysses")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 @pytest.mark.parametrize("method", ["ring", "ulysses"])
 def test_sp_segment_ids(cpu_devices, method):
     mesh = make_mesh(cpu_devices, sp=8)
