@@ -72,6 +72,12 @@ class ModelConfig:
     sequence_axis: Optional[str] = None
     sequence_method: str = "ring"   # "ring" | "ulysses"
 
+    # Pipeline parallelism: when pipeline_axis names a mesh axis of size > 1
+    # (the trainer sets this from ParallelConfig.pp), the layer stack runs as
+    # a GPipe pipeline with this many microbatches.
+    pipeline_axis: Optional[str] = None
+    pp_microbatches: int = 1
+
     # Gradient checkpointing policy for the layer scan:
     # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
     remat: str = "none"

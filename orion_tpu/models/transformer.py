@@ -345,13 +345,19 @@ def forward(
 ) -> tuple[jax.Array, jax.Array]:
     """tokens: [B, S] int32 -> (logits [B, S, V] float32, moe_aux scalar)."""
     B, S = tokens.shape
+    custom_positions = positions is not None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
     x = embed(params, tokens, positions, cfg)
 
     def block_fn(carry, bp):
-        y, aux = _block(carry, bp, cfg, positions, segment_ids, mesh)
+        pos = positions
+        if pos.shape[0] != carry.shape[0]:
+            # Pipeline microbatches are [mb, S, D] with mb < B; positions are
+            # batch-uniform there (validated below), so row 0 serves all.
+            pos = jnp.broadcast_to(pos[:1], (carry.shape[0], pos.shape[1]))
+        y, aux = _block(carry, bp, cfg, pos, segment_ids, mesh)
         return y, aux
 
     if cfg.remat == "full":
@@ -362,7 +368,30 @@ def forward(
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
 
-    if cfg.scan_layers:
+    pp_active = (
+        cfg.pipeline_axis is not None
+        and mesh is not None
+        and mesh.shape.get(cfg.pipeline_axis, 1) > 1
+    )
+    if pp_active:
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires scan_layers=True")
+        if segment_ids is not None or custom_positions:
+            raise ValueError(
+                "pipeline parallelism does not support packed sequences "
+                "(segment_ids/custom positions are per-row state)"
+            )
+        from orion_tpu.parallel.pipeline import pipeline_forward
+
+        x, moe_aux = pipeline_forward(
+            x,
+            params["blocks"],
+            block_fn,
+            mesh,
+            axis=cfg.pipeline_axis,
+            num_microbatches=cfg.pp_microbatches,
+        )
+    elif cfg.scan_layers:
         x, aux = jax.lax.scan(block_fn, x, params["blocks"])
         moe_aux = aux.sum()
     else:

@@ -17,6 +17,7 @@ from orion_tpu.parallel.sharding import (
     param_shardings,
     shard_init,
 )
+from orion_tpu.parallel.pipeline import pipeline_forward
 from orion_tpu.parallel.sequence import (
     ring_attention,
     sequence_attention,
@@ -29,6 +30,7 @@ __all__ = [
     "logical_to_spec",
     "param_shardings",
     "shard_init",
+    "pipeline_forward",
     "ring_attention",
     "sequence_attention",
     "ulysses_attention",

@@ -1,0 +1,133 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference pipelines layers across devices with NCCL p2p activation
+transfers and a microbatch schedule (SURVEY.md §3 "PP"; PAPERS.md:7). The
+TPU-native formulation here is SPMD, not MPMD: the stacked per-layer params
+[L, ...] are sharded contiguously over ``pp`` (rule "layers" -> "pp", so each
+device owns L/pp stage layers), and a ``shard_map`` that is *manual over pp
+only* runs the classic GPipe fill/drain schedule — each tick every stage
+applies its layers to its current microbatch and ``ppermute``s the activation
+one hop down the ring. All other mesh axes (dp/fsdp/tp/sp) stay in XLA's
+auto-sharding mode inside the pipeline body, so pipeline composes with data,
+ZeRO-3, tensor and sequence sharding without any manual collectives.
+
+Schedule notes: with M microbatches over S stages the bubble fraction is
+(S-1)/(M+S-1) — raise ``parallel.pp_microbatches`` to amortize. Bubble ticks
+compute on garbage and are masked out (uniform SPMD control flow beats a
+per-stage cond that would have to carry collectives). Backward is just
+``jax.grad`` through the scan: ppermute transposes into the reverse-direction
+ring, giving the synchronous GPipe backward schedule; combine with
+``model.remat='full'`` to keep activation memory at O(stage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+BlockFn = Callable[[jax.Array, Any], Tuple[jax.Array, jax.Array]]
+
+
+def pipeline_forward(
+    x: jax.Array,                 # [B, S, D] (batch auto-sharded on dp/fsdp)
+    blocks: Any,                  # stacked per-layer params, leaves [L, ...]
+    block_fn: BlockFn,            # (x [b,S,D], layer_params) -> (y, aux)
+    mesh: Mesh,
+    *,
+    axis: str = "pp",
+    num_microbatches: int = 1,
+) -> tuple[jax.Array, jax.Array]:
+    """Run the layer stack as a GPipe pipeline; returns (x_out, aux_sum).
+
+    Requirements (validated by the trainer): L % pp == 0, B % M == 0, and
+    per-sequence state like packed segment_ids must be absent (positions must
+    be batch-uniform, which the default arange positions are).
+    """
+    pp = mesh.shape.get(axis, 1)
+    if pp == 1:
+        def scan_fn(c, bp):
+            y, aux = block_fn(c, bp)
+            return y, aux
+        x, aux = lax.scan(scan_fn, x, blocks)
+        return x, aux.sum()
+
+    B, S, D = x.shape
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by pp_microbatches {M}")
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if L % pp:
+        raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+    mb = B // M
+
+    # [L, ...] -> [pp, L/pp, ...]: contiguous stage chunks, so this reshape
+    # is local for params sharded "layers" -> "pp".
+    staged = jax.tree.map(
+        lambda a: a.reshape(pp, L // pp, *a.shape[1:]), blocks
+    )
+    x_mb = x.reshape(M, mb, S, D)
+
+    def local(x_mb, staged):
+        stage_params = jax.tree.map(lambda a: a[0], staged)  # [L/pp, ...]
+        stage = lax.axis_index(axis)
+        npp = lax.axis_size(axis)
+        is_last = stage == npp - 1
+        T = M + npp - 1
+        fwd_perm = [(i, i + 1) for i in range(npp - 1)]
+
+        def run_stage(c):
+            def scan_fn(h, bp):
+                y, aux = block_fn(h, bp)
+                return y, aux
+            y, aux = lax.scan(scan_fn, c, stage_params)
+            return y, aux.sum()
+
+        def tick(carry, t):
+            state, outputs, aux_acc = carry
+            inject = x_mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(stage == 0, inject, state)
+            # Bubble ticks run on garbage and are masked below: uniform
+            # control flow keeps the auto-axis collectives unconditional.
+            out, aux_t = run_stage(cur)
+            active = (t >= stage) & (t - stage < M)
+            aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
+            out_idx = jnp.clip(t - (npp - 1), 0, M - 1)
+            outputs = outputs.at[out_idx].set(
+                jnp.where(is_last & active, out, outputs[out_idx])
+            )
+            state = lax.ppermute(out, axis, fwd_perm)
+            return (state, outputs, aux_acc), None
+
+        # The carries become device-varying over pp after the first tick, so
+        # their (replicated-zero) initial values must be cast to varying.
+        carry0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis,), to="varying"),
+            (
+                jnp.zeros_like(x_mb[0]),
+                jnp.zeros_like(x_mb),
+                jnp.zeros((), jnp.float32),
+            ),
+        )
+        (_, outputs, aux_acc), _ = lax.scan(tick, carry0, jnp.arange(T))
+        # Only the last stage holds real outputs; broadcast them (and the
+        # per-stage aux partial sums) to every stage. Per-layer aux values
+        # are batch means (e.g. the MoE balance loss), so average over the M
+        # microbatches to match the single-batch scan semantics.
+        outputs = lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis
+        )
+        aux = lax.psum(aux_acc, axis) / M
+        return outputs, aux
+
+    outputs, aux = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(), P()),
+        axis_names={axis},
+    )(x_mb, staged)
+    return outputs.reshape(B, S, D), aux

@@ -33,7 +33,10 @@ DEFAULT_RULES: dict[str, MeshAxes] = {
     "mlp": "tp",
     "vocab": "tp",
     "expert": "ep",
-    "layers": None,            # scan axis; pipeline stages shard this on pp
+    # Stacked-layer scan axis: contiguous L/pp chunks per pipeline stage
+    # (parallel.pipeline strips the stage dim inside its shard_map). With
+    # pp=1 the axis is elided and this is a no-op.
+    "layers": "pp",
     "pos": None,
 }
 

@@ -140,12 +140,35 @@ class Trainer:
     """
 
     def __init__(self, cfg: Config):
+        import dataclasses as _dc
+
+        if cfg.parallel.pp > 1:
+            # Route the layer stack through the GPipe pipeline over pp
+            # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
+            pp, M = cfg.parallel.pp, cfg.parallel.pp_microbatches
+            micro = cfg.data.batch_size // max(cfg.train.grad_accum, 1)
+            if cfg.model.n_layers % pp:
+                raise ValueError(
+                    f"model.n_layers={cfg.model.n_layers} must be divisible "
+                    f"by parallel.pp={pp}"
+                )
+            if M < 1 or micro % M:
+                raise ValueError(
+                    f"per-step batch {micro} must be divisible by "
+                    f"pp_microbatches={M}"
+                )
+            if not cfg.model.scan_layers:
+                raise ValueError("parallel.pp > 1 requires model.scan_layers")
+            cfg = _dc.replace(
+                cfg,
+                model=_dc.replace(
+                    cfg.model, pipeline_axis="pp", pp_microbatches=M
+                ),
+            )
         if cfg.parallel.sp > 1:
             # Route attention through ring/Ulysses over the sp axis
             # (parallel.sequence); all other layers are pointwise over the
             # sequence and stay sequence-sharded via the "seq" rule.
-            import dataclasses as _dc
-
             if cfg.data.seq_len % cfg.parallel.sp:
                 raise ValueError(
                     f"data.seq_len={cfg.data.seq_len} must be divisible by "
@@ -167,12 +190,6 @@ class Trainer:
                 ),
             )
         self.cfg = cfg
-        if cfg.parallel.pp > 1:
-            # Landed by parallel.pipeline integration; fail loudly rather
-            # than silently replicating work.
-            raise NotImplementedError(
-                "the pp mesh axis is not wired into the dense trainer yet"
-            )
         if cfg.data.batch_size % max(cfg.train.grad_accum, 1):
             raise ValueError(
                 f"grad_accum={cfg.train.grad_accum} must divide global batch "
