@@ -43,8 +43,10 @@ def main(argv: list[str] | None = None) -> int:
     from orion_tpu.config import get_config
     from orion_tpu.infer import InferenceEngine
     from orion_tpu.models import init_params
+    from orion_tpu.runtime import initialize
 
     cfg = get_config(args.preset, args.overrides)
+    initialize(cfg.runtime)
 
     prompts: list[list[int]] = []
     for spec in args.tokens:
@@ -60,10 +62,15 @@ def main(argv: list[str] | None = None) -> int:
 
     params = init_params(cfg.model, jax.random.key(cfg.train.seed))
     if cfg.checkpoint.directory:
+        # Trainer checkpoints hold the full train state; restore its shape
+        # tree and keep only the params for serving.
+        from orion_tpu.train.trainer import init_train_state
+
         mgr = CheckpointManager(cfg.checkpoint.directory, cfg.checkpoint)
-        restored = mgr.restore_latest(
-            {"params": jax.eval_shape(lambda: params)}
+        abstract = jax.eval_shape(
+            lambda: init_train_state(cfg, jax.random.key(cfg.train.seed))
         )
+        restored = mgr.restore_latest(abstract)
         if restored is not None:
             params = restored[0]["params"]
             print(f"restored checkpoint step {restored[1]}")

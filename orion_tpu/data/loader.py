@@ -90,13 +90,19 @@ class MemmapLoader(Loader):
             )
         self.n_windows = self.n_tokens - need + 1
 
-    def batch_at(self, step: int) -> Batch:
-        b, s = self.host_batch, self.cfg.seq_len
+    def _offsets_at(self, step: int) -> np.ndarray:
         rng = np.random.default_rng(
             (self.cfg.shuffle_seed, step, self.process_index)
         )
-        offs = rng.integers(0, self.n_windows, size=b)
-        rows = self.reader.gather(offs, s + 1)
+        return rng.integers(0, self.n_windows, size=self.host_batch)
+
+    def batch_at(self, step: int) -> Batch:
+        s = self.cfg.seq_len
+        rows = self.reader.gather(self._offsets_at(step), s + 1)
+        if hasattr(self.reader, "prefetch"):
+            # Deterministic stream: page in the next step's windows while
+            # this step trains (native reader issues MADV_WILLNEED).
+            self.reader.prefetch(self._offsets_at(step + 1), s + 1)
         rows = rows.astype(np.int32)
         return {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
 

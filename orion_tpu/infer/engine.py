@@ -116,21 +116,29 @@ class InferenceEngine:
         limit = self.icfg.max_seq_len
         if len(prompt) >= limit:
             raise ValueError(f"prompt length {len(prompt)} >= max_seq_len {limit}")
-        needed = self._bucket_len(len(prompt)) // self.psz + 1
+        max_new = (
+            max_new_tokens
+            if max_new_tokens is not None
+            else self.icfg.max_new_tokens
+        )
+        # The pool must be able to hold this request ALONE at its largest
+        # context (preemption can always shrink the batch to one, and a
+        # grown request re-prefills at its context's bucket length) plus one
+        # spare growth page — this makes mid-decode pool exhaustion
+        # unreachable for admitted requests.
+        max_context = min(len(prompt) + max(max_new, 0), limit)
+        needed = self._bucket_len(max_context) // self.psz + 1
         usable = self.icfg.num_pages - 1
         if needed > usable:
             raise ValueError(
-                f"prompt needs {needed} KV pages but the pool only has "
-                f"{usable}; raise inference.num_pages"
+                f"request needs up to {needed} KV pages but the pool only "
+                f"has {usable}; raise inference.num_pages or lower "
+                f"max_new_tokens"
             )
         req = Request(
             rid=next(self._rid),
             prompt=list(map(int, prompt)),
-            max_new_tokens=(
-                max_new_tokens
-                if max_new_tokens is not None
-                else self.icfg.max_new_tokens
-            ),
+            max_new_tokens=max_new,
         )
         self.waiting.append(req)
         return req.rid

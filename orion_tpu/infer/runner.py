@@ -99,8 +99,11 @@ def prefill_step(
 
     x = embed(params, tokens, positions, cfg)
     x, new_cache = _layer_iter(params, cache, cfg, body)(x)
-    logits = unembed(params, x, cfg)          # [1, S_pad, V]
-    return logits[0, length - 1], new_cache
+    # Only the last real position's logits are needed; slice before the LM
+    # head so the vocab matmul is [1, 1, V], not [1, S_pad, V].
+    x_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+    logits = unembed(params, x_last, cfg)     # [1, 1, V]
+    return logits[0, 0], new_cache
 
 
 def decode_step(

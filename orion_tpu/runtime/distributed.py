@@ -42,6 +42,17 @@ def initialize(cfg: Optional[RuntimeConfig] = None) -> RuntimeInfo:
     global _initialized
     cfg = cfg or RuntimeConfig()
 
+    if cfg.platform is not None and not _initialized:
+        # Restrict backend initialization to the requested platform before
+        # the first device query. On this dev box an always-registered TPU
+        # plugin otherwise initializes (or hangs, when its tunnel is down)
+        # even for runtime.platform="cpu" runs.
+        try:
+            jax.config.update("jax_platforms", cfg.platform)
+        except Exception:  # backends already initialized; keep going
+            log.warning("jax backends already initialized; cannot restrict "
+                        "platform to %s", cfg.platform)
+
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
     if cfg.deterministic:

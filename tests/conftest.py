@@ -22,6 +22,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (after XLA_FLAGS)
 import pytest  # noqa: E402
 
+# Tests are CPU-only (fake multi-device mesh). Force the platform *before*
+# any backend initialization: the axon TPU plugin registered by the
+# machine's sitecustomize hangs jax.devices() whenever its tunnel is down,
+# and no test needs the real chip. (This overrides the sitecustomize's own
+# jax_platforms="axon,cpu" setting.)
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

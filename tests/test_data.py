@@ -1,0 +1,92 @@
+"""Data pipeline tests: loader determinism, the native C++ reader vs the
+numpy fallback, and end-to-end memmap training."""
+
+import numpy as np
+import pytest
+
+from orion_tpu.config import DataConfig
+from orion_tpu.data.loader import (
+    MemmapLoader,
+    SyntheticLoader,
+    _NumpyReader,
+)
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("data") / "tokens.u16"
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 50000, size=20_000, dtype=np.uint16)
+    tokens.tofile(path)
+    return str(path), tokens
+
+
+def test_synthetic_deterministic_and_shifted():
+    cfg = DataConfig(batch_size=4, seq_len=32)
+    ldr = SyntheticLoader(cfg, 0, 1, vocab_size=256)
+    b1, b2 = ldr.batch_at(7), ldr.batch_at(7)
+    np.testing.assert_array_equal(b1["inputs"], b2["inputs"])
+    # targets are inputs shifted by one
+    b3 = ldr.batch_at(8)
+    assert not np.array_equal(b1["inputs"], b3["inputs"])
+    np.testing.assert_array_equal(b1["inputs"][:, 1:], b1["targets"][:, :-1])
+
+
+def test_native_reader_matches_numpy(token_file):
+    path, tokens = token_file
+    native = pytest.importorskip("orion_tpu.data.native")
+    rdr = native.NativeReader(path, np.uint16)
+    ref = _NumpyReader(path, np.dtype(np.uint16))
+    assert len(rdr) == len(ref) == len(tokens)
+    offs = np.asarray([0, 17, 5000, len(tokens) - 129])
+    np.testing.assert_array_equal(rdr.gather(offs, 129), ref.gather(offs, 129))
+    rdr.prefetch(offs, 129)  # smoke: readahead must not crash
+    rdr.close()
+
+
+def test_native_reader_bounds_check(token_file):
+    path, tokens = token_file
+    native = pytest.importorskip("orion_tpu.data.native")
+    rdr = native.NativeReader(path, np.uint16)
+    with pytest.raises(IndexError):
+        rdr.gather(np.asarray([len(tokens) - 10]), 129)
+    rdr.close()
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_memmap_loader_native_and_fallback_agree(token_file, use_native):
+    path, _ = token_file
+    cfg = DataConfig(source="memmap", path=path, batch_size=4, seq_len=64,
+                     use_native_loader=use_native)
+    ldr = MemmapLoader(cfg, 0, 1, vocab_size=50000)
+    batch = ldr.batch_at(3)
+    assert batch["inputs"].shape == (4, 64)
+    np.testing.assert_array_equal(batch["inputs"][:, 1:],
+                                  batch["targets"][:, :-1])
+    # Same (seed, step) -> same windows regardless of reader backend.
+    cfg2 = DataConfig(source="memmap", path=path, batch_size=4, seq_len=64,
+                      use_native_loader=not use_native)
+    ldr2 = MemmapLoader(cfg2, 0, 1, vocab_size=50000)
+    np.testing.assert_array_equal(batch["inputs"],
+                                  ldr2.batch_at(3)["inputs"])
+
+
+def test_memmap_training_smoke(token_file):
+    """train.py path over a real token file (memmap + native reader)."""
+    import jax
+
+    from orion_tpu.config import get_config
+    from orion_tpu.train import Trainer
+
+    path, _ = token_file
+    cfg = get_config("tiny", [
+        "runtime.platform=cpu",
+        "data.source=memmap", f"data.path={path}", "data.batch_size=4",
+        "data.seq_len=32", "model.vocab_size=50304",
+        "train.num_steps=3", "train.log_interval=100",
+        "optimizer.warmup_steps=1",
+    ])
+    t = Trainer(cfg)
+    state, _ = t.restore_or_init()
+    state, m = t.train_step(state, t.global_batch(0))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
