@@ -226,6 +226,9 @@ class TrainConfig:
     profile_dir: str = "/tmp/orion_tpu_profile"
     # Fault injection for recovery tests: raise at this step (SURVEY.md §6).
     inject_fault_at_step: Optional[int] = None
+    # Stall watchdog: alarm if no step completes within this many seconds
+    # (hung collective / dead peer host). None disables.
+    watchdog_timeout_s: Optional[float] = None
     # Device peak bf16 FLOP/s for MFU; None => autodetect from device kind.
     peak_flops_per_device: Optional[float] = None
     metrics_jsonl: Optional[str] = None

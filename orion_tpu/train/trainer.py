@@ -41,6 +41,12 @@ class FaultInjected(RuntimeError):
     """Raised by the --inject_fault_at_step test hook (SURVEY.md §6)."""
 
 
+# Each injected fault fires once per (checkpoint dir, step) per process, so
+# a supervisor restart that resumes from *before* the fault step does not
+# crash again on the same hook — mimicking a transient failure.
+_FIRED_FAULTS: set = set()
+
+
 def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
     params = init_params(cfg.model, key)
     return {
@@ -271,7 +277,14 @@ class Trainer:
 
     # -- loop -------------------------------------------------------------
 
-    def fit(self, state: Optional[TrainState] = None) -> list:
+    def fit(
+        self,
+        state: Optional[TrainState] = None,
+        preemption_handler: Optional[Any] = None,
+    ) -> list:
+        from orion_tpu.train.fault import Preempted, PreemptionHandler, Watchdog
+        import contextlib
+
         cfg = self.cfg
         if state is None:
             state, start = self.restore_or_init()
@@ -281,9 +294,24 @@ class Trainer:
         watch = metrics_lib.Stopwatch()
         tracing = False
         try:
+          with contextlib.ExitStack() as stack:
+            # An externally-managed handler (tests, schedulers) is used
+            # as-is; otherwise install our own for the duration of the loop.
+            preempt = (
+                preemption_handler
+                if preemption_handler is not None
+                else stack.enter_context(PreemptionHandler())
+            )
+            # Disabled no-op when watchdog_timeout_s is None.
+            watchdog = stack.enter_context(
+                Watchdog(cfg.train.watchdog_timeout_s)
+            )
             for step in range(start, cfg.train.num_steps):
                 if cfg.train.inject_fault_at_step == step:
-                    raise FaultInjected(f"injected fault at step {step}")
+                    key = (cfg.checkpoint.directory, step)
+                    if key not in _FIRED_FAULTS:
+                        _FIRED_FAULTS.add(key)
+                        raise FaultInjected(f"injected fault at step {step}")
                 if profile and step == profile[0]:
                     jax.profiler.start_trace(cfg.train.profile_dir)
                     tracing = True
@@ -291,6 +319,7 @@ class Trainer:
                 state, m = self.train_step(state, batch)
                 m = jax.device_get(m)
                 dt = watch.lap(sync_on=m["loss"])
+                watchdog.heartbeat()
                 self.metrics.record(
                     step=step + 1,
                     loss=m["loss"],
@@ -306,6 +335,13 @@ class Trainer:
                     tracing = False
                 if self.ckpt is not None:
                     self.ckpt.save(step + 1, state)
+                if preempt.preempted:
+                    # Step boundary: state is consistent. Persist and stop
+                    # cleanly; the supervisor restart resumes losslessly.
+                    if self.ckpt is not None:
+                        self.ckpt.save(step + 1, state, force=True)
+                        self.ckpt.wait()
+                    raise Preempted(f"preempted after step {step + 1}")
             if self.ckpt is not None:
                 self.ckpt.save(cfg.train.num_steps, state, force=True)
             return self.metrics.history

@@ -24,6 +24,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--list-presets", action="store_true")
     parser.add_argument("--print-config", action="store_true")
     parser.add_argument(
+        "--max-restarts", type=int, default=0,
+        help="supervisor mode: restart-and-resume after failures, up to N "
+             "times (resumes from the newest checkpoint)",
+    )
+    parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides, e.g. model.n_layers=4"
     )
     args = parser.parse_args(argv)
@@ -41,8 +46,21 @@ def main(argv: list[str] | None = None) -> int:
 
     from orion_tpu.train import Trainer
 
-    trainer = Trainer(cfg)
-    history = trainer.fit()
+    if args.max_restarts > 0:
+        from orion_tpu.train.fault import run_with_restarts
+
+        if not cfg.checkpoint.directory or not cfg.checkpoint.restore:
+            parser.error(
+                "--max-restarts needs checkpoint.directory set (and "
+                "checkpoint.restore=true): without it every restart would "
+                "silently retrain from step 0"
+            )
+        history = run_with_restarts(
+            lambda attempt: Trainer(cfg).fit(),
+            max_restarts=args.max_restarts,
+        )
+    else:
+        history = Trainer(cfg).fit()
     if history:
         last = history[-1]
         print(
