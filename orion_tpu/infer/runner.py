@@ -129,15 +129,30 @@ def decode_step(
         <= seq_lens[:, None, None]
     )                                                    # [B, 1, P*psz]
 
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(cfg.kernels)
+
     def body(x, bp, kl, vl):
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         K, H = k.shape[2], k.shape[3]
         kl = kl.at[page_idx, offset].set(k[:, 0])
         vl = vl.at[page_idx, offset].set(v[:, 0])
-        k_ctx = kl[page_table].reshape(B, P * psz, K, H)
-        v_ctx = vl[page_table].reshape(B, P * psz, K, H)
-        out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
+        if use_pallas:
+            # Ragged paged-attention kernel: walks the page table directly,
+            # compute proportional to actual context lengths.
+            from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+            out = paged_attention(
+                q[:, 0], kl, vl, page_table, seq_lens,
+                logit_softcap=cfg.attn_logit_softcap,
+                interpret=interpret,
+            )[:, None]
+        else:
+            k_ctx = kl[page_table].reshape(B, P * psz, K, H)
+            v_ctx = vl[page_table].reshape(B, P * psz, K, H)
+            out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
         x = x + out_proj(out, bp["attn"], cfg)
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)

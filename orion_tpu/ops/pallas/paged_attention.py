@@ -1,0 +1,158 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The inference engine's decode step attends one new token per sequence
+against that sequence's KV pages (PAPERS.md:9 "ragged paged attention for
+TPU LLM inference"; SURVEY.md §3 `ops`: fused attention, "ragged/paged
+variant for inference"). The jnp reference path materializes every
+sequence's full padded context via a pool gather; this kernel instead walks
+the page table directly:
+
+  - ``page_table``/``last_pos`` ride the scalar-prefetch channel, so each
+    grid step's k/v BlockSpec index map points the DMA at the NEXT physical
+    page while the current one computes — the gather never materializes.
+  - Grid is (batch, kv_head, page); the online-softmax state for one
+    (batch, kv_head) group lives in VMEM scratch across the page sweep.
+  - Pages past a sequence's length are skipped (`pl.when`), so compute is
+    proportional to the ragged ACTUAL context lengths, not the padded
+    maximum — the "ragged" in ragged paged attention.
+  - The grouped query heads of one kv head form the sublane dim (G rows,
+    padded to 8), the page size the lane dim: one MXU-shaped block per
+    (group, page) pair.
+
+Decode is inference-only; no VJP is defined.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from orion_tpu.ops.pallas.common import NEG_INF, resolve_interpret, round_up
+
+LANES = 128
+
+
+def _kernel(
+    softcap: Optional[float],
+    psz: int,
+    pt_ref,        # [B, P] scalar-prefetched page table
+    sl_ref,        # [B] scalar-prefetched last valid position per sequence
+    q_ref,         # [1, 1, G8, H]
+    k_ref,         # [1, psz, 1, H]
+    v_ref,         # [1, psz, 1, H]
+    o_ref,         # [1, 1, G8, H]
+    m_s,           # [G8, LANES] f32 scratch
+    l_s,           # [G8, LANES] f32 scratch
+    acc_s,         # [G8, H] f32 scratch
+):
+    b, ip = pl.program_id(0), pl.program_id(2)
+    npages = pl.num_programs(2)
+    last_pos = sl_ref[b]
+    scale = q_ref.shape[-1] ** -0.5
+
+    @pl.when(ip == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # Ragged skip: pages wholly beyond this sequence's context do nothing.
+    @pl.when(ip * psz <= last_pos)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G8, H]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)    # [psz, H]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        z = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # [G8, psz]
+        if softcap is not None:
+            z = softcap * jnp.tanh(z / softcap)
+        pos = ip * psz + jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
+        mask = pos <= last_pos
+        z = jnp.where(mask, z, NEG_INF)
+
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, z.max(axis=-1, keepdims=True))
+        p = jnp.exp(z - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)
+        l_s[:] = jnp.broadcast_to(
+            l_s[:, :1] * alpha + p.sum(axis=-1, keepdims=True), l_s.shape
+        )
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+
+    @pl.when(ip == npages - 1)
+    def _finish():
+        l = l_s[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,            # [B, N, H] (the new token's queries)
+    k_pool: jax.Array,       # [num_pages, psz, K, H]
+    v_pool: jax.Array,       # [num_pages, psz, K, H]
+    page_table: jax.Array,   # [B, P] int32 page ids per sequence
+    last_pos: jax.Array,     # [B] int32: highest valid position (inclusive)
+    *,
+    logit_softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Decode attention over the paged KV pool -> [B, N, H].
+
+    Semantics match gathering each sequence's pages into a [B, P*psz, K, H]
+    context and running masked attention (positions <= last_pos attend).
+    """
+    B, N, H = q.shape
+    num_pages, psz, K, _ = k_pool.shape
+    P = page_table.shape[1]
+    assert N % K == 0, (N, K)
+    G = N // K
+    G8 = max(round_up(G, 8), 8)
+
+    qg = q.reshape(B, K, G, H)
+    if G8 != G:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - G), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, P),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G8, H), lambda b, kh, ip, pt, sl: (b, kh, 0, 0)
+            ),
+            # The page-table lookup happens IN THE INDEX MAP: the DMA for
+            # grid step (b, kh, ip) reads physical page pt[b, ip].
+            pl.BlockSpec(
+                (1, psz, 1, H), lambda b, kh, ip, pt, sl: (pt[b, ip], 0, kh, 0)
+            ),
+            pl.BlockSpec(
+                (1, psz, 1, H), lambda b, kh, ip, pt, sl: (pt[b, ip], 0, kh, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G8, H), lambda b, kh, ip, pt, sl: (b, kh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G8, LANES), jnp.float32),
+            pltpu.VMEM((G8, LANES), jnp.float32),
+            pltpu.VMEM((G8, H), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, logit_softcap, psz),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, G8, H), q.dtype),
+        interpret=resolve_interpret(interpret),
+    )(page_table.astype(jnp.int32), last_pos.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out[:, :, :G, :].reshape(B, N, H)

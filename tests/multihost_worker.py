@@ -1,0 +1,38 @@
+"""Worker entry for the multi-host distributed test (run as a subprocess).
+
+Usage: python tests/multihost_worker.py <process_id> <num_processes> <port>
+
+Runs a short data-parallel training through the REAL runtime bring-up path
+(SURVEY.md §4 stack C): runtime.initialize -> jax.distributed rendezvous ->
+global mesh over both processes' CPU devices -> jit train loop with
+per-process batch shards. Prints one RESULT line with the loss history.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from orion_tpu.config import get_config
+    from orion_tpu.train import Trainer
+
+    cfg = get_config("tiny", [
+        "runtime.platform=cpu",
+        f"runtime.coordinator_address=127.0.0.1:{port}",
+        f"runtime.num_processes={n}",
+        f"runtime.process_id={pid}",
+        f"parallel.dp={n}",
+        "data.batch_size=4",
+        "train.num_steps=20",
+        "train.log_interval=1000",
+        "optimizer.warmup_steps=2",
+    ])
+    hist = Trainer(cfg).fit()
+    print("RESULT " + json.dumps([float(h.loss) for h in hist]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,21 @@ def test_engine_matches_full_forward(preset):
     assert out == ref
 
 
+def test_engine_pallas_kernels_match_xla():
+    """The full serving path on Pallas kernels (flash prefill + ragged paged
+    decode, interpret mode on CPU) must produce the xla path's tokens."""
+    cfg, params = _setup()
+    import dataclasses
+
+    pcfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, kernels="pallas_interpret")
+    )
+    prompt = [5, 3, 9, 250, 17]
+    ref = InferenceEngine(cfg, params).generate([prompt], 6)[0]
+    out = InferenceEngine(pcfg, params).generate([prompt], 6)[0]
+    assert out == ref
+
+
 def test_continuous_batching_preserves_outputs():
     """Batched serving (with queueing beyond max_batch_size) must not change
     any request's tokens."""

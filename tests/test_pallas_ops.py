@@ -228,3 +228,75 @@ class TestModelWithPallasKernels:
         np.testing.assert_allclose(
             logits_pallas, logits_xla, rtol=5e-4, atol=5e-4
         )
+
+
+# -- paged decode attention -------------------------------------------------
+
+
+def _paged_reference(q, k_pool, v_pool, page_table, last_pos):
+    from orion_tpu.ops.attention import attention_xla
+
+    B, N, H = q.shape
+    P = page_table.shape[1]
+    psz, K = k_pool.shape[1], k_pool.shape[2]
+    k_ctx = k_pool[page_table].reshape(B, P * psz, K, H)
+    v_ctx = v_pool[page_table].reshape(B, P * psz, K, H)
+    mask = (
+        jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+        <= last_pos[:, None, None]
+    )
+    return attention_xla(
+        q[:, None], k_ctx, v_ctx, causal=False, mask=mask
+    )[:, 0]
+
+
+@pytest.mark.parametrize("gqa", [(8, 8), (8, 2), (4, 1)])
+def test_paged_attention_matches_gather(gqa):
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    N, K = gqa
+    B, H, psz, P, num_pages = 3, 64, 16, 4, 32
+    keys = jax.random.split(jax.random.key(0), 4)
+    q = jax.random.normal(keys[0], (B, N, H), jnp.float32)
+    k_pool = jax.random.normal(keys[1], (num_pages, psz, K, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, psz, K, H), jnp.float32)
+    # Shuffled non-contiguous page assignment, ragged lengths.
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
+    )
+    last_pos = jnp.asarray([0, 37, 63], jnp.int32)  # 1, 38, 64 valid tokens
+
+    ref = _paged_reference(q, k_pool, v_pool, page_table, last_pos)
+    out = paged_attention(
+        q, k_pool, v_pool, page_table, last_pos, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_attention_softcap():
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    B, N, K, H, psz, P, num_pages = 2, 4, 2, 32, 8, 3, 16
+    keys = jax.random.split(jax.random.key(1), 4)
+    q = jax.random.normal(keys[0], (B, N, H), jnp.float32) * 4
+    k_pool = jax.random.normal(keys[1], (num_pages, psz, K, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, psz, K, H), jnp.float32)
+    page_table = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    last_pos = jnp.asarray([10, 20], jnp.int32)
+
+    from orion_tpu.ops.attention import attention_xla
+
+    k_ctx = k_pool[page_table].reshape(B, P * psz, K, H)
+    v_ctx = v_pool[page_table].reshape(B, P * psz, K, H)
+    mask = (
+        jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+        <= last_pos[:, None, None]
+    )
+    ref = attention_xla(
+        q[:, None], k_ctx, v_ctx, causal=False, mask=mask, logit_softcap=20.0
+    )[:, 0]
+    out = paged_attention(
+        q, k_pool, v_pool, page_table, last_pos,
+        logit_softcap=20.0, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
