@@ -286,6 +286,8 @@ def _attn_block(
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
             logit_softcap=cfg.attn_logit_softcap,
+            block_q=cfg.attn_block_q,
+            block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
         )
     else:
@@ -297,6 +299,8 @@ def _attn_block(
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
             logit_softcap=cfg.attn_logit_softcap,
+            block_q=cfg.attn_block_q,
+            block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
         )
     return out_proj(out, p, cfg)

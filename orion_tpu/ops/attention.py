@@ -103,6 +103,8 @@ def attention(
     kv_segment_ids: Optional[jax.Array] = None,
     logit_softcap: Optional[float] = None,
     q_offset: int = 0,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     impl: str = "xla",
 ) -> jax.Array:
     """Grouped-query scaled-dot-product attention. Shapes as attention_xla."""
@@ -126,6 +128,8 @@ def attention(
             kv_segment_ids=kv_segment_ids,
             logit_softcap=logit_softcap,
             q_offset=q_offset,
+            block_q=block_q,
+            block_kv=block_kv,
             interpret=interpret,
         )
     return attention_xla(

@@ -140,54 +140,65 @@ def _ring_attention_local(
     causal: bool,
     logit_softcap: Optional[float],
     impl: str = "xla",
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Per-device ring attention body (runs inside shard_map).
 
-    The blockwise unit is the jnp math in _block_attend regardless of
-    ``impl`` for now: the ring merge needs per-block log-sum-exps, which the
-    Pallas flash kernel does not yet expose as an output (tracked for the
-    kernel's residual-returning variant).
+    Under ``impl='pallas'`` the blockwise unit is the fused flash kernel via
+    ``flash_attention_with_lse`` (the lse output feeds the ring merge); under
+    'xla' it is the jnp math in _block_attend. Every ring position needs only
+    a *static* mask config — the local diagonal block is causal at relative
+    offset 0, fully-past blocks are unmasked, fully-future blocks are skipped
+    — so the kernel never needs a traced q_offset.
     """
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(impl)
     sp = lax.axis_size(axis)
     idx = lax.axis_index(axis)
-    s_local = q.shape[1]
-    q_off = idx * s_local
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     has_seg = q_seg is not None
 
-    def attend(k_c, v_c, seg_c, src):
-        kv_off = src * s_local
+    def block(k_, v_, seg_, diag: bool):
+        """Attend local q against one KV block; diag => causally masked."""
+        if use_pallas:
+            from orion_tpu.ops.pallas.flash_attention import (
+                flash_attention_with_lse,
+            )
 
-        def compute(kv):
-            k_, v_, seg_ = kv
-            return _block_attend(
+            o, lse = flash_attention_with_lse(
                 q, k_, v_,
-                q_offset=q_off, kv_offset=kv_off, causal=causal,
+                causal=causal and diag,
                 q_segment_ids=q_seg if has_seg else None,
                 kv_segment_ids=seg_ if has_seg else None,
                 logit_softcap=logit_softcap,
+                block_q=block_q,
+                block_kv=block_kv,
+                interpret=interpret,
             )
+            return o.astype(jnp.float32), lse
+        zero = jnp.zeros((), jnp.int32)
+        return _block_attend(
+            q, k_, v_,
+            q_offset=zero, kv_offset=zero, causal=causal and diag,
+            q_segment_ids=q_seg if has_seg else None,
+            kv_segment_ids=seg_ if has_seg else None,
+            logit_softcap=logit_softcap,
+        )
 
-        if not causal:
-            return compute((k_c, v_c, seg_c))
-
-        # Blocks entirely in the masked future (src > idx) contribute
-        # nothing; skip their matmuls instead of masking them to -inf.
-        # (The compute skew this leaves across the ring is resolved the
-        # standard way — see the module docstring on striping.)
-        def empty(kv):
-            b, sq, n, h = q.shape
-            return (
-                jnp.zeros((b, sq, n, h), jnp.float32),
-                jnp.full((b, n, sq), -jnp.inf, jnp.float32),
-            )
-
-        return lax.cond(src <= idx, compute, empty, (k_c, v_c, seg_c))
-
-    # Step 0 attends the local KV block; the scan then does exactly sp-1
-    # rotate->attend steps (no trailing rotation whose result is discarded).
+    # Step 0 attends the local (diagonal) KV block; the scan then does
+    # exactly sp-1 rotate->attend steps (no trailing rotation whose result
+    # is discarded).
     seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
-    o_acc, l_acc = attend(k, v, seg0, idx)
+    o_acc, l_acc = block(k, v, seg0, True)
+
+    def empty(kv):
+        b, sq, n, h = q.shape
+        return (
+            jnp.zeros((b, sq, n, h), jnp.float32),
+            jnp.full((b, n, sq), -jnp.inf, jnp.float32),
+        )
 
     def step(carry, t):
         k_cur, v_cur, seg_cur, o, l = carry
@@ -196,7 +207,19 @@ def _ring_attention_local(
         if has_seg:
             seg_cur = lax.ppermute(seg_cur, axis, perm)
         src = jnp.mod(idx - t, sp)
-        o_blk, l_blk = attend(k_cur, v_cur, seg_cur, src)
+        if causal:
+            # Blocks entirely in the masked future (src > idx) contribute
+            # nothing; skip their matmuls instead of masking them to -inf.
+            # (The compute skew this leaves across the ring is resolved the
+            # standard way — see the module docstring on striping.)
+            o_blk, l_blk = lax.cond(
+                src < idx,
+                lambda kv: block(*kv, False),
+                empty,
+                (k_cur, v_cur, seg_cur),
+            )
+        else:
+            o_blk, l_blk = block(k_cur, v_cur, seg_cur, False)
         o, l = _merge_blocks(o, l, o_blk, l_blk)
         return (k_cur, v_cur, seg_cur, o, l), None
 
@@ -223,6 +246,8 @@ def _ulysses_local(
     causal: bool,
     logit_softcap: Optional[float],
     impl: str = "xla",
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Per-device Ulysses body: a2a to full-seq / sharded-heads, attend, a2a
     back (runs inside shard_map). ``impl`` selects the local attention kernel
@@ -243,6 +268,8 @@ def _ulysses_local(
         q_segment_ids=q_seg,
         kv_segment_ids=kv_seg,
         logit_softcap=logit_softcap,
+        block_q=block_q,
+        block_kv=block_kv,
         impl=impl,
     )
     # [b, S, n_loc/sp, h] -> [b, s_loc, n_loc, h]
@@ -275,6 +302,8 @@ def sequence_attention(
     batch_axes: BatchAxes = ("dp", "fsdp"),
     head_axis: Optional[str] = "tp",
     impl: str = "xla",
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel grouped-query causal attention.
 
@@ -313,7 +342,8 @@ def sequence_attention(
 
     body = _ring_attention_local if method == "ring" else _ulysses_local
     fn = partial(
-        body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl
+        body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl,
+        block_q=block_q, block_kv=block_kv,
     )
     qkv_spec, seg_spec = _specs(axis, batch_axes, head_axis)
 

@@ -124,6 +124,60 @@ def test_ulysses_pallas_kernel(cpu_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_pallas_kernel(cpu_devices, causal):
+    """Ring attention's blockwise unit under impl='pallas' is the fused flash
+    kernel via flash_attention_with_lse (VERDICT r2 item 3); interpret mode
+    on the fake CPU mesh, exact against the single-device reference."""
+    mesh = make_mesh(cpu_devices, sp=4)
+    q, k, v = _qkv(jax.random.key(10), s=256, h=64)
+    ref = attention_xla(q, k, v, causal=causal)
+    out = jax.jit(
+        lambda q, k, v: sequence_attention(
+            q, k, v, mesh, method="ring", causal=causal,
+            impl="pallas_interpret",
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_pallas_gqa_segments(cpu_devices):
+    mesh = make_mesh(cpu_devices, sp=4)
+    q, k, v = _qkv(jax.random.key(11), s=256, n=8, k_heads=2, h=64)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 100), jnp.int32), jnp.ones((2, 156), jnp.int32)], axis=1
+    )
+    ref = attention_xla(q, k, v, causal=True, q_segment_ids=seg,
+                        kv_segment_ids=seg)
+    out = sequence_attention(
+        q, k, v, mesh, method="ring", q_segment_ids=seg, kv_segment_ids=seg,
+        impl="pallas_interpret",
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_pallas_gradients_match(cpu_devices):
+    """Gradients flow through the kernel's lse output (the dlse term folds
+    into the flash backward's delta): must match the xla reference."""
+    mesh = make_mesh(cpu_devices, sp=4)
+    q, k, v = _qkv(jax.random.key(12), s=256, h=64)
+
+    def loss_ref(q, k, v):
+        return (attention_xla(q, k, v, causal=True) ** 2).sum()
+
+    def loss_sp(q, k, v):
+        out = sequence_attention(
+            q, k, v, mesh, method="ring", causal=True,
+            impl="pallas_interpret",
+        )
+        return (out ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_sp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
 def test_ulysses_rejects_bad_heads(cpu_devices):
     mesh = make_mesh(cpu_devices, sp=8)
     q, k, v = _qkv(jax.random.key(7), n=4, k_heads=2)  # 4 heads, sp=8
