@@ -4,32 +4,46 @@
 The judged metric (BASELINE.json:2) is tokens/sec/chip + MFU for Llama-3-8B
 on v5p; the dev box has one v5e-class chip, so this benchmarks the flagship
 architecture at a size that saturates a single chip (llama-1b-bench preset:
-Llama-3 architecture, bf16, remat, fused kernels when enabled) and reports
-MFU against the 45% north-star (BASELINE.json:5).
+Llama-3 architecture, bf16, remat, fused Pallas kernels) and reports MFU
+against the 45% north-star (BASELINE.json:5).
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints the PRIMARY training line first, then a serving-throughput line
+(BASELINE config 5: continuous-batching decode):
+    {"metric": "llama_flagship_train_mfu", "value": N, "unit": ...}
+    {"metric": "llama_flagship_decode_tput", "value": N, "unit": ...}
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import time
 
 BASELINE_MFU = 0.45  # north-star target, BASELINE.json:5
 
 WARMUP_STEPS = 3  # excluded from timing (includes XLA compile)
 
+# Serving bench shape: max_batch_size concurrent streams, short prompts.
+DECODE_BATCH = 32
+PROMPT_LEN = 64
+DECODE_WARMUP = 4    # engine steps (each = one decode window)
+DECODE_TIMED = 20    # engine steps
 
-def main() -> int:
+HBM_BYTES_PER_SEC = {
+    # bf16-era HBM bandwidth per chip; decode is bandwidth-bound, so MBU
+    # (memory-bandwidth utilization) is the roofline for tokens/sec.
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+}
+
+
+def bench_train(overrides) -> int:
     import jax
 
     from orion_tpu.config import get_config
     from orion_tpu.train import Trainer
 
-    # Silence per-step logging so stdout is exactly one JSON line; user
-    # overrides can still re-enable it.
-    overrides = ["train.log_interval=100000"] + sys.argv[1:]
     cfg = get_config("llama-1b-bench", overrides)
     trainer = Trainer(cfg)
     history = trainer.fit()
@@ -55,6 +69,102 @@ def main() -> int:
     }
     print(json.dumps(result))
     return 0
+
+
+def bench_infer(overrides) -> int:
+    """Continuous-batching decode throughput (BASELINE config 5).
+
+    DECODE_BATCH concurrent streams on the flagship bench model; measures
+    steady-state engine steps (scheduler + fused decode+sample program +
+    the per-step [B] token fetch) and reports tokens/sec/chip plus MBU
+    against the HBM roofline (decode is bandwidth-bound: every step reads
+    all params + the active KV pages).
+    """
+    import jax
+    import numpy as np
+
+    from orion_tpu.config import get_config
+    from orion_tpu.infer import InferenceEngine
+    from orion_tpu.models import init_params
+
+    cfg = get_config(
+        "llama-1b-bench",
+        [
+            "model.param_dtype=bfloat16",  # serving keeps bf16 weights
+            f"inference.max_batch_size={DECODE_BATCH}",
+            "inference.max_seq_len=1024",
+            "inference.page_size=64",
+            "inference.num_pages=640",
+            "inference.prefill_chunk=64",
+            "inference.max_new_tokens=100000",  # never finish mid-bench
+        ]
+        + list(overrides),
+    )
+    params = init_params(cfg.model, jax.random.key(0))
+    eng = InferenceEngine(cfg, params)
+    rng = np.random.default_rng(0)
+    for _ in range(DECODE_BATCH):
+        eng.submit(rng.integers(1, cfg.model.vocab_size, PROMPT_LEN).tolist())
+
+    def total_generated():
+        return sum(len(r.generated) for r in eng.slots if r is not None)
+
+    for _ in range(DECODE_WARMUP):   # includes prefill + decode compiles
+        eng.step()
+    n0 = total_generated()
+    t0 = time.perf_counter()
+    for _ in range(DECODE_TIMED):
+        eng.step()
+    dt = time.perf_counter() - t0
+    n_tokens = total_generated() - n0
+
+    dev = jax.devices()[0]
+    tok_per_sec = n_tokens / dt
+    device_steps_per_sec = n_tokens / DECODE_BATCH / dt
+    # Bandwidth model: params once per decode step + K+V for the mean
+    # context (decode is bandwidth-bound; this ratio is the roofline MBU).
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+    )
+    m = cfg.model
+    mean_ctx = PROMPT_LEN + (n0 + n_tokens // 2) // DECODE_BATCH
+    kv_bytes = (
+        DECODE_BATCH * mean_ctx * m.n_layers * m.n_kv_heads
+        * m.resolved_head_dim * 2 * 2
+    )
+    hbm = HBM_BYTES_PER_SEC.get(dev.device_kind)
+    mbu = (
+        (param_bytes + kv_bytes) * device_steps_per_sec / hbm
+        if hbm else None
+    )
+
+    result = {
+        "metric": "llama_flagship_decode_tput",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mbu, 4) if mbu is not None else None,
+        "mbu": round(mbu, 4) if mbu is not None else None,
+        "decode_batch": DECODE_BATCH,
+        "decode_window": cfg.inference.decode_window,
+        "steps_per_sec": round(device_steps_per_sec, 2),
+        "device": dev.device_kind,
+        "model": cfg.model.name,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> int:
+    # Silence per-step logging so stdout is exactly the JSON lines; user
+    # overrides can still re-enable it.
+    overrides = ["train.log_interval=100000"] + sys.argv[1:]
+    rc = bench_train(overrides)
+    try:
+        rc |= bench_infer(sys.argv[1:])
+    except Exception as e:  # the training line is the judged primary
+        print(json.dumps({"metric": "llama_flagship_decode_tput",
+                          "error": repr(e)}))
+    return rc
 
 
 if __name__ == "__main__":

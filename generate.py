@@ -62,15 +62,16 @@ def main(argv: list[str] | None = None) -> int:
 
     params = init_params(cfg.model, jax.random.key(cfg.train.seed))
     if cfg.checkpoint.directory:
-        # Trainer checkpoints hold the full train state; restore its shape
-        # tree and keep only the params for serving.
-        from orion_tpu.train.trainer import init_train_state
+        # Trainer checkpoints hold the full train state; restore through the
+        # SHARDED abstract state (NamedShardings attached), so a 70B-class
+        # checkpoint reads directly into its mesh layout instead of
+        # materializing host-side (a shapes-only eval_shape restore would
+        # host-OOM at the sizes this CLI advertises).
+        from orion_tpu.train.trainer import abstract_train_state
 
-        mgr = CheckpointManager(cfg.checkpoint.directory, cfg.checkpoint)
-        abstract = jax.eval_shape(
-            lambda: init_train_state(cfg, jax.random.key(cfg.train.seed))
-        )
-        restored = mgr.restore_latest(abstract)
+        restored = CheckpointManager(
+            cfg.checkpoint.directory, cfg.checkpoint
+        ).restore_latest(abstract_train_state(cfg))
         if restored is not None:
             params = restored[0]["params"]
             print(f"restored checkpoint step {restored[1]}")

@@ -200,8 +200,8 @@ class ParallelConfig:
 
 @dataclass(frozen=True)
 class DataConfig:
-    source: str = "synthetic"       # "synthetic" | "memmap" | "hf"
-    path: Optional[str] = None       # token file (memmap) or dataset name (hf)
+    source: str = "synthetic"       # "synthetic" | "memmap"
+    path: Optional[str] = None       # token file (memmap)
     batch_size: int = 8              # global batch, in sequences
     seq_len: int = 1024
     shuffle_seed: int = 0
@@ -236,6 +236,10 @@ class TrainConfig:
     # Stall watchdog: alarm if no step completes within this many seconds
     # (hung collective / dead peer host). None disables.
     watchdog_timeout_s: Optional[float] = None
+    # What the watchdog does on stall: "log" (default) or "abort" (SIGABRT
+    # the process so a supervisor restart resumes from the checkpoint — a
+    # hung collective is unrecoverable in-process).
+    watchdog_action: str = "log"
     # Device peak bf16 FLOP/s for MFU; None => autodetect from device kind.
     peak_flops_per_device: Optional[float] = None
     metrics_jsonl: Optional[str] = None
@@ -252,6 +256,10 @@ class InferenceConfig:
     top_k: int = 0
     top_p: float = 1.0
     max_new_tokens: int = 128
+    # Decode steps fused per engine step (one dispatch + ONE host fetch per
+    # window). Larger windows amortize host round-trips — tens of ms on a
+    # tunneled chip — at the cost of decoding past EOS by up to W-1 tokens.
+    decode_window: int = 8
 
 
 @dataclass(frozen=True)
@@ -264,6 +272,11 @@ class RuntimeConfig:
     platform: Optional[str] = None
     deterministic: bool = False       # bitwise-reproducible mode
     debug_nans: bool = False          # TPU-native sanitizer (SURVEY.md §6)
+    # checkify validation mode (SURVEY.md §6 "Race detection / sanitizers"):
+    # functionalized device-side float (nan/inf) + out-of-bounds-index
+    # checks on the train step, raised host-side after each step. Slower
+    # (adds a per-step error fetch); see SANITIZERS.md.
+    checkify: bool = False
 
 
 @dataclass(frozen=True)

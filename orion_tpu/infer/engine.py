@@ -23,7 +23,7 @@ import numpy as np
 
 from orion_tpu.config import Config
 from orion_tpu.infer.kv_cache import PageAllocator, init_cache, pages_per_seq
-from orion_tpu.infer.runner import decode_step, prefill_step
+from orion_tpu.infer.runner import decode_window, prefill_step
 from orion_tpu.infer.sampling import sample
 
 log = logging.getLogger("orion_tpu.infer")
@@ -98,10 +98,21 @@ class InferenceEngine:
         self.preemptions = 0
 
         self._decode = jax.jit(
-            partial(decode_step, cfg=self.mcfg), donate_argnums=(1,)
+            partial(
+                decode_window,
+                cfg=self.mcfg,
+                max_seq_len=self.icfg.max_seq_len,
+                temperature=self.icfg.temperature,
+                top_k=self.icfg.top_k,
+                top_p=self.icfg.top_p,
+            ),
+            donate_argnums=(1,),
         )
-        # One prefill specialization per padded bucket length (S_pad is a
-        # static shape; the jit cache keys on it automatically).
+        # One prefill specialization per (padded bucket length, padded batch
+        # size) pair — both static shapes; the jit cache keys on them
+        # automatically. Admission batches same-bucket prompts into one
+        # dispatch and rounds the batch up to a power of two to bound the
+        # number of specializations.
         self._prefill = jax.jit(
             partial(prefill_step, cfg=self.mcfg), donate_argnums=(1,)
         )
@@ -122,12 +133,18 @@ class InferenceEngine:
             else self.icfg.max_new_tokens
         )
         # The pool must be able to hold this request ALONE at its largest
-        # context (preemption can always shrink the batch to one, and a
+        # footprint (preemption can always shrink the batch to one, and a
         # grown request re-prefills at its context's bucket length) plus one
-        # spare growth page — this makes mid-decode pool exhaustion
-        # unreachable for admitted requests.
+        # spare page — this makes mid-decode pool exhaustion unreachable for
+        # admitted requests. The footprint includes the decode window's
+        # pre-provisioned pages: the device may write up to W-1 positions
+        # past the host's final accepted token (see runner.decode_window).
         max_context = min(len(prompt) + max(max_new, 0), limit)
-        needed = self._bucket_len(max_context) // self.psz + 1
+        worst = min(max_context + self.icfg.decode_window, limit)
+        needed = max(
+            self._bucket_len(max_context),
+            -(-worst // self.psz) * self.psz,
+        ) // self.psz + 1
         usable = self.icfg.num_pages - 1
         if needed > usable:
             raise ValueError(
@@ -144,8 +161,9 @@ class InferenceEngine:
         return req.rid
 
     def step(self) -> list[Request]:
-        """Admit + prefill new requests, decode one token for all active
-        slots; returns the requests that finished this step."""
+        """Admit + prefill new requests, then run one decode WINDOW
+        (inference.decode_window fused token steps, one host round-trip)
+        for all active slots; returns the requests that finished."""
         self._admit()
         self._decode_all()
         done, self._just_finished = self._just_finished, []
@@ -177,42 +195,67 @@ class InferenceEngine:
         return min(-(-n // chunk) * chunk, self.icfg.max_seq_len)
 
     def _admit(self) -> None:
+        # Pass 1 (host): claim slots + pages for every admissible request,
+        # preserving arrival order (head-of-line blocking on resources).
+        admitted: list[tuple[Request, int]] = []
         while self.waiting:
             req = self.waiting[0]
             slot = next(
                 (i for i, r in enumerate(self.slots) if r is None), None
             )
             if slot is None:
-                return
+                break
             context = req.context
             s_pad = self._bucket_len(len(context))
             n_pages = s_pad // self.psz
             if self.alloc.free_pages < n_pages + 1:
-                return  # head-of-line blocking: keep arrival order
+                break  # head-of-line blocking: keep arrival order
             self.waiting.popleft()
             req.slot = slot
             req.admit_seq = next(self._admit_seq)
             req.pages = self.alloc.alloc(n_pages)
             self.slots[slot] = req
-
-            tokens = np.zeros((1, s_pad), np.int32)
-            tokens[0, : len(context)] = context
-            logits, self.cache = self._prefill(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.int32(len(context)),
-                jnp.asarray(np.asarray(req.pages, np.int32)),
-            )
             self.page_table[slot, :n_pages] = req.pages
             self.seq_lens[slot] = len(context)
+            admitted.append((req, s_pad))
+
+        # Pass 2 (device): ONE prefill dispatch per bucket length, the whole
+        # admission burst batched (VERDICT r2 item 4). Rows are padded up to
+        # a power-of-two batch so jit specializations stay bounded.
+        by_bucket: dict[int, list[Request]] = {}
+        for req, s_pad in admitted:
+            by_bucket.setdefault(s_pad, []).append(req)
+        for s_pad, reqs in by_bucket.items():
+            self._prefill_bucket(reqs, s_pad)
+
+    def _prefill_bucket(self, reqs: list[Request], s_pad: int) -> None:
+        """Prefill a group of same-bucket admitted requests in one dispatch."""
+        n_pages = s_pad // self.psz
+        nb = 1 << (len(reqs) - 1).bit_length()   # next power of two
+        tokens = np.zeros((nb, s_pad), np.int32)
+        lengths = np.ones(nb, np.int32)          # pad rows: length 1
+        pages = np.zeros((nb, n_pages), np.int32)  # pad rows: scratch page 0
+        for i, req in enumerate(reqs):
+            context = req.context
+            tokens[i, : len(context)] = context
+            lengths[i] = len(context)
+            pages[i] = req.pages
+        logits, self.cache = self._prefill(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(pages),
+        )
+        firsts = self._sample(logits)
+        for i, req in enumerate(reqs):
             if req.max_new_tokens <= 0:
                 req.done = True   # prefill-only (scoring) request
                 continue
-            first = self._sample(logits[None, :])[0]
-            self.last_token[slot] = first
-            req.generated.append(int(first))
-            self._maybe_finish(req, int(first))
+            first = int(firsts[i])
+            self.last_token[req.slot] = first
+            req.generated.append(first)
+            self._maybe_finish(req, first)
 
     def _preempt(self, req: Request) -> None:
         """Evict an active request, returning its pages; it re-enters at the
@@ -230,9 +273,12 @@ class InferenceEngine:
         self.waiting.appendleft(req)
 
     def _grow_pages(self) -> None:
-        """Allocate a fresh page for every slot whose next token starts a new
-        page, preempting the youngest-admitted request under pool pressure
-        (oldest requests keep making progress; no mid-decode crash)."""
+        """Pre-provision every active slot with pages covering the whole
+        upcoming decode window (the device writes up to W positions ahead of
+        the host's view, including past mid-window EOS), preempting the
+        youngest-admitted request under pool pressure (oldest requests keep
+        making progress; no mid-decode crash)."""
+        W = self.icfg.decode_window
         by_age = sorted(
             (r for r in self.slots if r is not None and not r.done),
             key=lambda r: r.admit_seq,
@@ -241,22 +287,23 @@ class InferenceEngine:
             if req.slot is None:
                 continue  # preempted earlier in this pass
             pos = int(self.seq_lens[req.slot])
-            if pos % self.psz or pos // self.psz < len(req.pages):
-                continue
-            while self.alloc.free_pages < 1:
-                victims = [
-                    r for r in by_age
-                    if r.slot is not None and r is not req
-                ]
-                if not victims:
-                    raise MemoryError(
-                        "KV pool too small for a single request; raise "
-                        "inference.num_pages"
-                    )
-                self._preempt(victims[-1])
-            page = self.alloc.alloc(1)[0]
-            self.page_table[req.slot, len(req.pages)] = page
-            req.pages.append(page)
+            last = min(pos + W - 1, self.icfg.max_seq_len - 1)
+            n_need = min(last // self.psz + 1, self.pages_per_seq)
+            while len(req.pages) < n_need:
+                while self.alloc.free_pages < 1:
+                    victims = [
+                        r for r in by_age
+                        if r.slot is not None and r is not req
+                    ]
+                    if not victims:
+                        raise MemoryError(
+                            "KV pool too small for a single request; raise "
+                            "inference.num_pages"
+                        )
+                    self._preempt(victims[-1])
+                page = self.alloc.alloc(1)[0]
+                self.page_table[req.slot, len(req.pages)] = page
+                req.pages.append(page)
 
     def _decode_all(self) -> None:
         self._grow_pages()
@@ -264,20 +311,30 @@ class InferenceEngine:
         if not active:
             self._reap()
             return
-        logits, self.cache = self._decode(
+        W = self.icfg.decode_window
+        mask = np.array(
+            [r is not None and not r.done for r in self.slots], bool
+        )
+        self._key, sub = jax.random.split(self._key)
+        toks, self.cache = self._decode(
             self.params,
             self.cache,
-            jnp.asarray(self.last_token[:, None]),
+            jnp.asarray(self.last_token),
             jnp.asarray(self.seq_lens),
             jnp.asarray(self.page_table),
+            jnp.asarray(mask),
+            jax.random.split(sub, W),
         )
-        tokens = self._sample(logits)
-        for req in active:
-            tok = int(tokens[req.slot])
-            self.seq_lens[req.slot] += 1
-            self.last_token[req.slot] = tok
-            req.generated.append(tok)
-            self._maybe_finish(req, tok)
+        tokens = np.asarray(jax.device_get(toks))   # [W, B], ONE fetch
+        for j in range(W):
+            for req in active:
+                if req.done:
+                    continue  # finished mid-window; discard overshoot
+                tok = int(tokens[j, req.slot])
+                self.seq_lens[req.slot] += 1
+                self.last_token[req.slot] = tok
+                req.generated.append(tok)
+                self._maybe_finish(req, tok)
         self._reap()
 
     def _sample(self, logits: jax.Array) -> np.ndarray:

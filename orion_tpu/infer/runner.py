@@ -3,17 +3,32 @@
 The reference serves generation through prefill/decode phases over a KV
 cache (BASELINE.json:11; SURVEY.md §4 stack B). TPU-native shape discipline:
 
-  - ``prefill_step`` processes one prompt padded to a static bucket length
-    (one jit specialization per bucket), runs ordinary causal (flash)
-    attention, and scatters the computed K/V pages into the pool.
-  - ``decode_step`` advances ALL batch slots one token in a single program of
-    fully static shape: scatter the new token's K/V into each sequence's
-    current page, gather each sequence's pages, and attend under a
-    length mask. Inactive slots point at the reserved scratch page 0 and are
-    masked by seq_len only — no dynamic batch shapes anywhere.
+  - ``prefill_step`` processes a batch of same-bucket prompts padded to a
+    static bucket length (one jit specialization per bucket/batch pair),
+    runs ordinary causal (flash) attention, and scatters the computed K/V
+    pages into the pool.
+  - ``decode_window`` advances ALL batch slots ``n_steps`` tokens in a
+    single program of fully static shape, sampling fused in: scatter each
+    new token's K/V into each sequence's current page, attend via the
+    ragged paged kernel (or a masked gather under xla), sample, feed the
+    token back — one dispatch and ONE host fetch per window, which matters
+    because a device->host fetch costs tens of ms through a remote-chip
+    tunnel while a dispatch costs ~1 ms.
+
+Memory discipline (the part that makes decode bandwidth-bound instead of
+copy-bound): the KV pool is a single flat [L*num_pages, K, psz, H] array
+(heads-major pages — see kv_cache.py) carried through the layer scan, and
+every update is a sparse in-place write at rows ``l*num_pages + page`` —
+performed INSIDE the paged-attention kernel on the pallas path, because an
+external scatter feeding a custom call makes XLA materialize a fresh pool
+copy per layer. Carrying per-layer pool slices as scan xs/ys instead makes
+XLA rewrite the whole pool every step (measured 5.4 GB/step on the 1B
+bench model — 20x the useful traffic).
 
 Model math is shared with training via models.transformer.qkv_proj /
 out_proj / mlp_or_moe — the cache runner only changes what attention reads.
+Inactive batch slots point at the reserved scratch page 0 and are masked by
+seq_lens alone — no dynamic batch shapes anywhere.
 """
 
 from __future__ import annotations
@@ -39,126 +54,202 @@ from orion_tpu.ops.attention import attention_xla
 Cache = dict[str, jax.Array]
 
 
-def _layer_iter(params: Params, cache: Cache, cfg: ModelConfig, body):
-    """Run ``body(x, bp, k_pool_l, v_pool_l) -> (x, k_pool_l, v_pool_l)``
-    over all layers, scanning when the params are stacked."""
+def _scan_layers(params: Params, cfg: ModelConfig, body, init_carry):
+    """Run ``body(carry, bp, l) -> carry`` over all layers; ``l`` is the
+    layer index (traced under scan, static ints otherwise)."""
+    L = cfg.n_layers
+    if cfg.scan_layers:
+        def scan_body(carry, xs):
+            bp, l = xs
+            return body(carry, bp, l), None
 
-    def scan_body(x, xs):
-        bp, kl, vl = xs
-        x, kl, vl = body(x, bp, kl, vl)
-        return x, (kl, vl)
-
-    def run(x):
-        if cfg.scan_layers:
-            x, (new_k, new_v) = jax.lax.scan(
-                scan_body, x, (params["blocks"], cache["k"], cache["v"])
-            )
-        else:
-            ks, vs = [], []
-            for i, bp in enumerate(params["blocks"]):
-                x, kl, vl = body(x, bp, cache["k"][i], cache["v"][i])
-                ks.append(kl)
-                vs.append(vl)
-            new_k, new_v = jnp.stack(ks), jnp.stack(vs)
-        return x, {"k": new_k, "v": new_v}
-
-    return run
+        carry, _ = jax.lax.scan(
+            scan_body, init_carry, (params["blocks"], jnp.arange(L))
+        )
+        return carry
+    carry = init_carry
+    for l, bp in enumerate(params["blocks"]):
+        carry = body(carry, bp, l)
+    return carry
 
 
 def prefill_step(
     params: Params,
     cache: Cache,
-    tokens: jax.Array,        # [1, S_pad]  (padded prompt)
-    length: jax.Array,        # scalar int32: true prompt length
-    pages: jax.Array,         # [S_pad // page_size] int32 page ids
+    tokens: jax.Array,        # [Nb, S_pad]  (padded prompts, one bucket)
+    lengths: jax.Array,       # [Nb] int32: true prompt lengths
+    pages: jax.Array,         # [Nb, S_pad // page_size] int32 page ids
     cfg: ModelConfig,
 ) -> tuple[jax.Array, Cache]:
-    """Prefill one prompt; returns (next-token logits [V], updated cache)."""
-    S_pad = tokens.shape[1]
+    """Prefill a batch of same-bucket prompts in ONE dispatch.
+
+    Returns (next-token logits [Nb, V], updated cache). Rows are independent
+    sequences (separate page sets); a burst of admissions is served by a
+    single program instead of Nb serialized dispatches (VERDICT r2 item 4).
+    Padding rows (engine rounds the batch up to a bucket size) carry
+    all-zero page lists: their K/V lands on the reserved scratch page 0 and
+    is never read.
+    """
+    Nb, S_pad = tokens.shape
     psz = cache["k"].shape[2]
+    NP = cache["k"].shape[0] // cfg.n_layers
     n_pages = S_pad // psz
     positions = jnp.broadcast_to(
-        jnp.arange(S_pad, dtype=jnp.int32), (1, S_pad)
+        jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
     )
 
-    def body(x, bp, kl, vl):
+    def body(carry, bp, l):
+        x, kp, vp = carry
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
-        out = attention(q, k, v, causal=True, impl=cfg.kernels)
+        out = attention(
+            q, k, v, causal=True,
+            block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+            impl=cfg.kernels,
+        )
         x = x + out_proj(out, bp["attn"], cfg)
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)
         x = x + y
-        # Scatter this layer's K/V pages into the pool. Positions beyond
-        # `length` hold garbage from the padding — decode masks them out
-        # via seq_lens, and the next real token overwrites its slot.
+        # Scatter this layer's K/V pages into the pool (in-place on the
+        # carried flat pool). Positions beyond each row's `length` hold
+        # garbage from the padding — decode masks them out via seq_lens,
+        # and the next real token overwrites its slot.
         K, H = k.shape[2], k.shape[3]
-        kl = kl.at[pages].set(k[0].reshape(n_pages, psz, K, H))
-        vl = vl.at[pages].set(v[0].reshape(n_pages, psz, K, H))
-        return x, kl, vl
+        rows = l * NP + pages                    # [Nb, n_pages]
+        # Pool pages are [K, psz, H] (heads major, see kv_cache.py).
+        kpages = k.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
+        vpages = v.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
+        kp = kp.at[rows].set(kpages)
+        vp = vp.at[rows].set(vpages)
+        return x, kp, vp
 
     x = embed(params, tokens, positions, cfg)
-    x, new_cache = _layer_iter(params, cache, cfg, body)(x)
-    # Only the last real position's logits are needed; slice before the LM
-    # head so the vocab matmul is [1, 1, V], not [1, S_pad, V].
-    x_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
-    logits = unembed(params, x_last, cfg)     # [1, 1, V]
-    return logits[0, 0], new_cache
+    x, kp, vp = _scan_layers(
+        params, cfg, body, (x, cache["k"], cache["v"])
+    )
+    # Only each row's last real position is needed; gather before the LM
+    # head so the vocab matmul is [Nb, 1, V], not [Nb, S_pad, V].
+    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
+    x_last = jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, (Nb, 1, x.shape[-1])), axis=1
+    )
+    logits = unembed(params, x_last, cfg)     # [Nb, 1, V]
+    return logits[:, 0], {"k": kp, "v": vp}
 
 
-def decode_step(
+def _decode_core(
     params: Params,
-    cache: Cache,
-    tokens: jax.Array,        # [B, 1]  newest token per slot
-    seq_lens: jax.Array,      # [B] int32: tokens already in cache per slot
-    page_table: jax.Array,    # [B, pages_per_seq] int32
+    kp: jax.Array,
+    vp: jax.Array,
+    tokens: jax.Array,        # [B] newest token per slot
+    write_pos: jax.Array,     # [B] int32 position being written/attended
+    page_table: jax.Array,    # [B, pages_per_seq] int32 (per-layer-relative)
     cfg: ModelConfig,
-) -> tuple[jax.Array, Cache]:
-    """One decode step for every slot; returns (logits [B, V], cache)."""
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode forward for every slot -> (logits [B, V], kp, vp)."""
     B = tokens.shape[0]
-    psz = cache["k"].shape[2]
+    psz = kp.shape[2]
+    NP = kp.shape[0] // cfg.n_layers
     P = page_table.shape[1]
-    positions = seq_lens[:, None]              # new token's position [B, 1]
+    positions = write_pos[:, None]
     batch_idx = jnp.arange(B)
 
-    page_idx = page_table[batch_idx, seq_lens // psz]   # [B]
-    offset = seq_lens % psz                              # [B]
-    # KV positions valid after the write: arange <= seq_len.
+    page_idx = page_table[batch_idx, write_pos // psz]   # [B]
+    offset = write_pos % psz                             # [B]
+    # KV positions valid after the write: arange <= write_pos.
     kv_mask = (
         jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
-        <= seq_lens[:, None, None]
+        <= write_pos[:, None, None]
     )                                                    # [B, 1, P*psz]
 
     from orion_tpu.ops._dispatch import resolve_impl
 
     use_pallas, interpret = resolve_impl(cfg.kernels)
 
-    def body(x, bp, kl, vl):
+    def body(carry, bp, l):
+        x, kp, vp = carry
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         K, H = k.shape[2], k.shape[3]
-        kl = kl.at[page_idx, offset].set(k[:, 0])
-        vl = vl.at[page_idx, offset].set(v[:, 0])
         if use_pallas:
-            # Ragged paged-attention kernel: walks the page table directly,
-            # compute proportional to actual context lengths.
+            # Ragged paged-attention kernel: walks the page table directly
+            # (compute proportional to actual context lengths) and writes
+            # the new token's K/V itself — the pool stays in place through
+            # the kernel's input/output aliasing, where an external scatter
+            # feeding the kernel would cost a pool copy per layer.
             from orion_tpu.ops.pallas.paged_attention import paged_attention
 
-            out = paged_attention(
-                q[:, 0], kl, vl, page_table, seq_lens,
+            out, kp, vp = paged_attention(
+                q[:, 0], kp, vp, page_table, write_pos,
+                layer_base=l * NP,
+                k_new=k[:, 0], v_new=v[:, 0],
                 logit_softcap=cfg.attn_logit_softcap,
                 interpret=interpret,
-            )[:, None]
+            )
+            out = out[:, None]
         else:
-            k_ctx = kl[page_table].reshape(B, P * psz, K, H)
-            v_ctx = vl[page_table].reshape(B, P * psz, K, H)
+            rows = l * NP + page_idx
+            kp = kp.at[rows, :, offset].set(k[:, 0])
+            vp = vp.at[rows, :, offset].set(v[:, 0])
+            # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather.
+            k_ctx = kp[l * NP + page_table].transpose(0, 1, 3, 2, 4)
+            v_ctx = vp[l * NP + page_table].transpose(0, 1, 3, 2, 4)
+            k_ctx = k_ctx.reshape(B, P * psz, K, H)
+            v_ctx = v_ctx.reshape(B, P * psz, K, H)
             out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
         x = x + out_proj(out, bp["attn"], cfg)
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)
-        return x + y, kl, vl
+        return x + y, kp, vp
 
-    x = embed(params, tokens, positions, cfg)
-    x, new_cache = _layer_iter(params, cache, cfg, body)(x)
+    x = embed(params, tokens[:, None], positions, cfg)
+    x, kp, vp = _scan_layers(params, cfg, body, (x, kp, vp))
     logits = unembed(params, x, cfg)          # [B, 1, V]
-    return logits[:, 0], new_cache
+    return logits[:, 0], kp, vp
+
+
+def decode_window(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B] newest token per slot
+    seq_lens: jax.Array,      # [B] int32
+    page_table: jax.Array,    # [B, pages_per_seq] int32
+    active: jax.Array,        # [B] bool: slot holds a live request
+    keys: jax.Array,          # [W] PRNG keys, one per inner step
+    cfg: ModelConfig,
+    max_seq_len: int,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+) -> tuple[jax.Array, Cache]:
+    """W fused decode+sample steps; returns (tokens [W, B] int32, cache).
+
+    The engine fetches the whole [W, B] token block once per window and does
+    its bookkeeping (EOS, max_new, admission) on the host afterwards; slots
+    that finish mid-window keep decoding garbage the host discards — wasted
+    FLOPs traded for W-fold fewer host round-trips. Slots advance only while
+    ``active`` and within the context window; frozen slots clamp their
+    write position to max_seq_len - 1 (their own last slot — garbage there
+    is unreachable because the host has already finished them).
+    """
+    from orion_tpu.infer.sampling import sample
+
+    def stepf(carry, sub):
+        tok, sl, kp, vp = carry
+        act = active & (sl < max_seq_len)
+        wp = jnp.minimum(sl, max_seq_len - 1)
+        logits, kp, vp = _decode_core(
+            params, kp, vp, tok, wp, page_table, cfg
+        )
+        toks = sample(
+            logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        tok = jnp.where(act, toks, tok)
+        sl = sl + act.astype(sl.dtype)
+        return (tok, sl, kp, vp), toks
+
+    (_, _, kp, vp), toks = jax.lax.scan(
+        stepf, (tokens, seq_lens, cache["k"], cache["v"]), keys
+    )
+    return toks, {"k": kp, "v": vp}

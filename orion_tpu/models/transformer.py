@@ -330,11 +330,18 @@ def _block(
     segment_ids: Optional[jax.Array],
     mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """One transformer block. Returns (x, moe_aux_loss)."""
-    x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
-                        positions, segment_ids, mesh)
-    h = _norm(x, bp["mlp_norm"], cfg)
-    y, aux = mlp_or_moe(h, bp, cfg)
+    """One transformer block. Returns (x, moe_aux_loss).
+
+    jax.named_scope annotations label the phases in profiler traces
+    (SURVEY.md §6 "Tracing / profiling": xprof shows attention vs mlp time
+    per block without guessing from fused-op names).
+    """
+    with jax.named_scope("attention"):
+        x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
+                            positions, segment_ids, mesh)
+    with jax.named_scope("mlp_moe"):
+        h = _norm(x, bp["mlp_norm"], cfg)
+        y, aux = mlp_or_moe(h, bp, cfg)
     return x + y, aux
 
 
@@ -353,7 +360,8 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
-    x = embed(params, tokens, positions, cfg)
+    with jax.named_scope("embed"):
+        x = embed(params, tokens, positions, cfg)
 
     def block_fn(carry, bp):
         pos = positions
@@ -404,7 +412,9 @@ def forward(
             x, aux = block_fn(x, bp)
             moe_aux = moe_aux + aux
 
-    return unembed(params, x, cfg), moe_aux
+    with jax.named_scope("unembed"):
+        logits = unembed(params, x, cfg)
+    return logits, moe_aux
 
 
 def loss_fn(

@@ -128,9 +128,17 @@ class Watchdog:
         timeout_s: Optional[float],
         on_stall: Optional[Callable[[float], None]] = None,
         poll_s: Optional[float] = None,
+        action: str = "log",
     ):
+        if action not in ("log", "abort"):
+            raise ValueError(f"unknown watchdog action {action!r}")
         self.timeout_s = timeout_s
-        self.on_stall = on_stall or self._default_on_stall
+        if on_stall is not None:
+            self.on_stall = on_stall
+        elif action == "abort":
+            self.on_stall = self._abort_on_stall
+        else:
+            self.on_stall = self._default_on_stall
         self._poll_s = (
             poll_s if poll_s is not None
             else min((timeout_s or 40.0) / 4, 10.0)
@@ -146,6 +154,23 @@ class Watchdog:
             "watchdog: no step completed for %.1fs — suspect hung "
             "collective or dead peer host", elapsed,
         )
+
+    @staticmethod
+    def _abort_on_stall(elapsed: float) -> None:
+        """Kill the process so the (cross-process) supervisor restarts it.
+
+        A hung collective cannot be recovered in-process — the device queue
+        is wedged — so detection must feed the restart loop: SIGABRT takes
+        the whole process down and the supervisor (re-run of train.py, or
+        an external scheduler) resumes from the latest checkpoint.
+        """
+        import os
+
+        log.error(
+            "watchdog: no step completed for %.1fs — aborting for "
+            "supervisor restart (hung collective / dead peer host)", elapsed,
+        )
+        os.kill(os.getpid(), signal.SIGABRT)
 
     def heartbeat(self) -> None:
         self._last = time.monotonic()

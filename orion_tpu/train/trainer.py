@@ -68,6 +68,27 @@ def state_shardings(cfg: Config, mesh) -> TrainState:
     }
 
 
+def abstract_train_state(cfg: Config, shardings=None) -> TrainState:
+    """ShapeDtypeStructs (with NamedShardings) of the full train state.
+
+    The sharding-aware restore template: Orbax reads each leaf directly into
+    its mesh layout instead of materializing host-side (a 70B state would
+    host-OOM otherwise). Free function so non-training consumers (e.g. the
+    serving CLI restoring params from a trainer checkpoint) don't need a
+    Trainer; ``shardings`` defaults to the production rules on a fresh mesh.
+    """
+    if shardings is None:
+        mesh = build_mesh(cfg.parallel, platform=cfg.runtime.platform)
+        shardings = state_shardings(cfg, mesh)
+    key = jax.random.key(cfg.train.seed)
+    shapes = jax.eval_shape(lambda: init_train_state(cfg, key))
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        shardings,
+    )
+
+
 def make_train_step(
     cfg: Config,
     schedule: Callable[[jax.Array], jax.Array],
@@ -115,11 +136,13 @@ def make_train_step(
 
     def train_step(state: TrainState, batch):
         params = state["params"]
-        loss, aux, grads = loss_and_grads(params, batch)
+        with jax.named_scope("fwd_bwd"):
+            loss, aux, grads = loss_and_grads(params, batch)
         lr = schedule(state["opt"]["count"]).astype(jnp.float32)
-        new_params, new_opt, opt_metrics = apply_updates(
-            params, grads, state["opt"], cfg.optimizer, lr
-        )
+        with jax.named_scope("optimizer"):
+            new_params, new_opt, opt_metrics = apply_updates(
+                params, grads, state["opt"], cfg.optimizer, lr
+            )
         new_state = {
             "params": new_params,
             "opt": new_opt,
@@ -207,9 +230,30 @@ class Trainer:
         self.batch_shard = self._batch_sharding()
         self.loader = make_loader(cfg.data, cfg.model.vocab_size)
         schedule = make_schedule(cfg.optimizer, cfg.train.num_steps)
-        self.train_step = jax.jit(
-            make_train_step(self.cfg, schedule, self.mesh), donate_argnums=(0,)
-        )
+        base_step = make_train_step(self.cfg, schedule, self.mesh)
+        if cfg.runtime.checkify:
+            # Sanitizer mode (SURVEY.md §6, SANITIZERS.md): functionalized
+            # device-side nan/inf + index-OOB checks; the error pytree is
+            # fetched and thrown host-side after every step.
+            from jax.experimental import checkify as _checkify
+
+            # float_checks only: this jax version's index-check rewrite
+            # trips over take_along_axis's fill-mode gather in the loss
+            # (IndexError during trace); OOB indexing on TPU is instead
+            # covered by the clamping semantics + the paged/packed tests.
+            checked = jax.jit(
+                _checkify.checkify(base_step, errors=_checkify.float_checks),
+                donate_argnums=(0,),
+            )
+
+            def _checked_step(state, batch):
+                err, out = checked(state, batch)
+                _checkify.check_error(err)
+                return out
+
+            self.train_step = _checked_step
+        else:
+            self.train_step = jax.jit(base_step, donate_argnums=(0,))
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint.directory:
             self.ckpt = CheckpointManager(
@@ -242,13 +286,7 @@ class Trainer:
         return jax.jit(init, out_shardings=self.shardings)()
 
     def abstract_state(self) -> TrainState:
-        key = jax.random.key(self.cfg.train.seed)
-        shapes = jax.eval_shape(lambda: init_train_state(self.cfg, key))
-        return jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            shapes,
-            self.shardings,
-        )
+        return abstract_train_state(self.cfg, shardings=self.shardings)
 
     def restore_or_init(self) -> tuple[TrainState, int]:
         if self.ckpt is not None and self.cfg.checkpoint.restore:
@@ -304,7 +342,8 @@ class Trainer:
             )
             # Disabled no-op when watchdog_timeout_s is None.
             watchdog = stack.enter_context(
-                Watchdog(cfg.train.watchdog_timeout_s)
+                Watchdog(cfg.train.watchdog_timeout_s,
+                         action=cfg.train.watchdog_action)
             )
             for step in range(start, cfg.train.num_steps):
                 if cfg.train.inject_fault_at_step == step:

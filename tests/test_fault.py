@@ -131,6 +131,27 @@ def test_watchdog_detects_stall_and_recovers():
         assert len(fired) == 1      # no re-fire while fresh
 
 
+def test_watchdog_abort_action_signals_process(monkeypatch):
+    """action='abort' closes the recovery loop: on stall the watchdog
+    SIGABRTs the process so the supervisor restart resumes from the
+    checkpoint (a hung collective is unrecoverable in-process)."""
+    import os
+    import signal as _signal
+
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append((pid, sig)))
+    with Watchdog(timeout_s=0.2, poll_s=0.05, action="abort") as wd:
+        wd.heartbeat()
+        time.sleep(0.5)
+        assert wd.stalled
+    assert kills == [(os.getpid(), _signal.SIGABRT)]
+
+
+def test_watchdog_rejects_unknown_action():
+    with pytest.raises(ValueError, match="action"):
+        Watchdog(timeout_s=1.0, action="explode")
+
+
 def test_run_with_restarts_config_errors_not_retried():
     attempts = []
 

@@ -102,6 +102,27 @@ def test_mid_flight_admission():
     assert [r.generated for r in by_rid] == [ref1, ref2]
 
 
+def test_burst_admission_prefills_in_one_dispatch():
+    """A burst of same-bucket admissions must be served by ONE batched
+    prefill dispatch, not one per prompt (VERDICT r2 item 4)."""
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    calls = []
+    orig = eng._prefill
+
+    def counting(*args):
+        calls.append(args[2].shape)  # tokens [Nb, S_pad]
+        return orig(*args)
+
+    eng._prefill = counting
+    prompts = [[5, 3, 9], [1, 2], [7, 8, 9, 10], [4]]
+    for p in prompts:
+        eng.submit(p, 4)
+    eng.step()
+    assert len(calls) == 1, calls
+    assert calls[0][0] == 4, calls  # all four prompts in one batch
+
+
 def test_eos_stops_generation():
     cfg, params = _setup()
     prompt = [5, 3, 9]

@@ -238,9 +238,12 @@ def _paged_reference(q, k_pool, v_pool, page_table, last_pos):
 
     B, N, H = q.shape
     P = page_table.shape[1]
-    psz, K = k_pool.shape[1], k_pool.shape[2]
-    k_ctx = k_pool[page_table].reshape(B, P * psz, K, H)
-    v_ctx = v_pool[page_table].reshape(B, P * psz, K, H)
+    K, psz = k_pool.shape[1], k_pool.shape[2]
+    # Pool pages are [K, psz, H] (kv_cache.py layout).
+    k_ctx = k_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    v_ctx = v_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
     mask = (
         jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
         <= last_pos[:, None, None]
@@ -258,8 +261,8 @@ def test_paged_attention_matches_gather(gqa):
     B, H, psz, P, num_pages = 3, 64, 16, 4, 32
     keys = jax.random.split(jax.random.key(0), 4)
     q = jax.random.normal(keys[0], (B, N, H), jnp.float32)
-    k_pool = jax.random.normal(keys[1], (num_pages, psz, K, H), jnp.float32)
-    v_pool = jax.random.normal(keys[2], (num_pages, psz, K, H), jnp.float32)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.float32)
     # Shuffled non-contiguous page assignment, ragged lengths.
     page_table = jnp.asarray(
         [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
@@ -273,21 +276,60 @@ def test_paged_attention_matches_gather(gqa):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_paged_attention_fused_write():
+    """The in-kernel KV write (input/output-aliased pool) must equal an
+    external scatter followed by attention."""
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    N, K = 8, 2
+    B, H, psz, P, num_pages = 3, 64, 16, 4, 32
+    keys = jax.random.split(jax.random.key(3), 6)
+    q = jax.random.normal(keys[0], (B, N, H), jnp.float32)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.float32)
+    k_new = jax.random.normal(keys[3], (B, K, H), jnp.float32)
+    v_new = jax.random.normal(keys[4], (B, K, H), jnp.float32)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
+    )
+    last_pos = jnp.asarray([0, 37, 63], jnp.int32)  # the position written
+
+    # Reference: scatter externally, then attend.
+    rows = page_table[jnp.arange(B), last_pos // psz]
+    kp_ref = k_pool.at[rows, :, last_pos % psz].set(k_new)
+    vp_ref = v_pool.at[rows, :, last_pos % psz].set(v_new)
+    ref = _paged_reference(q, kp_ref, vp_ref, page_table, last_pos)
+
+    out, kp, vp = paged_attention(
+        q, k_pool, v_pool, page_table, last_pos,
+        k_new=k_new, v_new=v_new, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(kp[rows, :, last_pos % psz]), np.asarray(k_new), atol=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(vp[rows, :, last_pos % psz]), np.asarray(v_new), atol=0
+    )
+
+
 def test_paged_attention_softcap():
     from orion_tpu.ops.pallas.paged_attention import paged_attention
 
     B, N, K, H, psz, P, num_pages = 2, 4, 2, 32, 8, 3, 16
     keys = jax.random.split(jax.random.key(1), 4)
     q = jax.random.normal(keys[0], (B, N, H), jnp.float32) * 4
-    k_pool = jax.random.normal(keys[1], (num_pages, psz, K, H), jnp.float32)
-    v_pool = jax.random.normal(keys[2], (num_pages, psz, K, H), jnp.float32)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.float32)
     page_table = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
     last_pos = jnp.asarray([10, 20], jnp.int32)
 
     from orion_tpu.ops.attention import attention_xla
 
-    k_ctx = k_pool[page_table].reshape(B, P * psz, K, H)
-    v_ctx = v_pool[page_table].reshape(B, P * psz, K, H)
+    k_ctx = k_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    v_ctx = v_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
     mask = (
         jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
         <= last_pos[:, None, None]

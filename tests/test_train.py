@@ -115,3 +115,21 @@ def test_memmap_loader_roundtrip(tmp_path):
     # Window contiguity: targets are inputs shifted by one.
     np.testing.assert_array_equal(b1["inputs"][:, 1:], b1["targets"][:, :-1])
     assert b1["inputs"].shape == (4, 32)
+
+
+def test_checkify_mode_catches_nan():
+    """runtime.checkify=true (SANITIZERS.md): device-side float checks on
+    the train step, raised host-side. A healthy step passes; NaN-corrupted
+    params raise instead of silently poisoning the run."""
+    import jax.numpy as jnp
+
+    cfg = _cfg(extra=("runtime.checkify=true", "train.num_steps=2"))
+    t = Trainer(cfg)
+    state, _ = t.restore_or_init()
+    state, m = t.train_step(state, t.global_batch(0))   # healthy: no raise
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    emb = state["params"]["embed"]["tokens"]
+    state["params"]["embed"]["tokens"] = emb.at[0, 0].set(jnp.nan)
+    with pytest.raises(Exception, match="(?i)nan"):
+        t.train_step(state, t.global_batch(1))
