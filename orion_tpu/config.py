@@ -77,7 +77,8 @@ class ModelConfig:
     # mesh axis of size > 1 (the trainer sets this from ParallelConfig.sp),
     # attention runs as ring attention or Ulysses over that axis.
     sequence_axis: Optional[str] = None
-    sequence_method: str = "ring"   # "ring" | "ulysses"
+    # "ring" | "ring_striped" (load-balanced zigzag-class layout) | "ulysses"
+    sequence_method: str = "ring"
 
     # Pipeline parallelism: when pipeline_axis names a mesh axis of size > 1
     # (the trainer sets this from ParallelConfig.pp), the layer stack runs as
@@ -178,7 +179,7 @@ class ParallelConfig:
     pp: int = 1
     sp: int = 1
     ep: int = 1
-    # Attention algorithm when sp > 1: "ring" | "ulysses".
+    # Attention algorithm when sp > 1: "ring" | "ring_striped" | "ulysses".
     sequence_method: str = "ring"
     # Pipeline microbatches (pp > 1). Must divide the per-step batch.
     pp_microbatches: int = 1

@@ -208,7 +208,16 @@ class InferenceEngine:
             context = req.context
             s_pad = self._bucket_len(len(context))
             n_pages = s_pad // self.psz
-            if self.alloc.free_pages < n_pages + 1:
+            # Reserve enough for the prefill AND the first decode window's
+            # pre-provisioning (positions up to context+W-1): admitting on
+            # the prefill footprint alone would let _grow_pages preempt the
+            # request right back out in the same step when W > page_size.
+            last = min(
+                len(context) + self.icfg.decode_window - 1,
+                self.icfg.max_seq_len - 1,
+            )
+            first_window = min(last // self.psz + 1, self.pages_per_seq)
+            if self.alloc.free_pages < max(n_pages + 1, first_window):
                 break  # head-of-line blocking: keep arrival order
             self.waiting.popleft()
             req.slot = slot

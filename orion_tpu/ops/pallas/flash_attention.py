@@ -51,25 +51,72 @@ class _Statics:
     block_q: int
     block_kv: int
     interpret: bool
+    # Explicit per-token positions provided (striped/permuted layouts):
+    # causal masking compares position ARRAYS instead of index iotas, and
+    # the causal block-skip becomes a dynamic min/max test on them.
+    has_pos: bool = False
 
 
-def _block_mask(st: _Statics, iq, ik, qseg_ref, kseg_ref):
+def _unpack_refs(has_seg: bool, has_pos: bool, refs):
+    """(q, k, v, qseg, kseg, qpos, kpos, rest) from a kernel's ref list.
+
+    Input order matches _io_args: q, k, v, [qseg, kseg], [qpos, kpos], then
+    the kernel-specific inputs/outputs/scratch in ``rest``.
+    """
+    i = 3
+    qseg = kseg = qpos = kpos = None
+    if has_seg:
+        qseg, kseg = refs[i], refs[i + 1]
+        i += 2
+    if has_pos:
+        qpos, kpos = refs[i], refs[i + 1]
+        i += 2
+    return refs[0], refs[1], refs[2], qseg, kseg, qpos, kpos, refs[i:]
+
+
+def _block_mask(st: _Statics, iq, ik, qseg_ref, kseg_ref, qpos_ref, kpos_ref):
     """[bq, bk] bool mask for grid cell (iq, ik); True = attend.
 
-    qseg_ref/kseg_ref hold the FULL padded sequence of segment ids (blocked
-    (1, 1, S) — TPU tiling forbids (1, bq) blocks); sliced here by grid cell.
+    qseg/kseg (and qpos/kpos) hold the FULL padded sequence of per-token
+    ids (blocked (1, 1, S) — TPU tiling forbids (1, bq) blocks); sliced
+    here by grid cell.
     """
     bq, bk = st.block_q, st.block_kv
-    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    kv_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    mask = kv_pos < st.seq_kv  # kv padding
+    kv_idx = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = kv_idx < st.seq_kv  # kv padding
     if st.causal:
-        mask &= (q_pos + st.q_offset) >= kv_pos
+        if st.has_pos:
+            q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
+            kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
+            mask &= q_ids[:, None] >= kv_ids[None, :]
+        else:
+            q_pos = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
+            mask &= (q_pos + st.q_offset) >= kv_idx
     if qseg_ref is not None:
         q_ids = qseg_ref[0, 0, pl.ds(iq * bq, bq)]
         kv_ids = kseg_ref[0, 0, pl.ds(ik * bk, bk)]
         mask &= q_ids[:, None] == kv_ids[None, :]
     return mask
+
+
+def _block_run(st: _Statics, iq, ik, qpos_ref, kpos_ref):
+    """Causal block-skip condition for grid cell (iq, ik).
+
+    Index mode: static-shape comparison on block indices. Position mode:
+    dynamic — a block is skippable only if its largest q position precedes
+    its smallest kv position (stripe layouts make this the common case for
+    half the blocks, preserving the 2x causal saving)."""
+    if not st.causal:
+        return True
+    bq, bk = st.block_q, st.block_kv
+    if st.has_pos:
+        q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
+        kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
+        return jnp.max(q_ids) >= jnp.min(kv_ids)
+    q_max = iq * bq + bq - 1 + st.q_offset
+    return ik * bk <= q_max
 
 
 def _scaled_logits(st: _Statics, q, k, scale):
@@ -90,16 +137,12 @@ def _scaled_logits(st: _Statics, q, k, scale):
 
 
 def _fwd_kernel(st: _Statics, has_seg, *refs):
-    if has_seg:
-        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
-        qseg, kseg = qseg_ref, kseg_ref
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
-        qseg = kseg = None
+    (q_ref, k_ref, v_ref, qseg, kseg, qpos, kpos,
+     (o_ref, lse_ref, m_s, l_s, acc_s)) = _unpack_refs(
+        has_seg, st.has_pos, refs)
 
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
-    bq = st.block_q
     scale = q_ref.shape[-1] ** -0.5
 
     @pl.when(ik == 0)
@@ -108,9 +151,8 @@ def _fwd_kernel(st: _Statics, has_seg, *refs):
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    # Skip blocks strictly above the causal diagonal.
-    q_max = iq * bq + bq - 1 + st.q_offset
-    run = (not st.causal) | (ik * st.block_kv <= q_max)
+    # Skip blocks with nothing visible under the causal mask.
+    run = _block_run(st, iq, ik, qpos, kpos)
 
     @pl.when(run)
     def _body():
@@ -118,7 +160,7 @@ def _fwd_kernel(st: _Statics, has_seg, *refs):
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         z, _ = _scaled_logits(st, q, k, scale)
-        mask = _block_mask(st, iq, ik, qseg, kseg)
+        mask = _block_mask(st, iq, ik, qseg, kseg, qpos, kpos)
         z = jnp.where(mask, z, NEG_INF)
 
         m_prev = m_s[:, :1]                       # [bq, 1]
@@ -146,25 +188,19 @@ def _fwd_kernel(st: _Statics, has_seg, *refs):
 
 
 def _dq_kernel(st: _Statics, has_seg, *refs):
-    if has_seg:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         qseg_ref, kseg_ref, dq_ref, dq_s) = refs
-        qseg, kseg = qseg_ref, kseg_ref
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s = refs
-        qseg = kseg = None
+    (q_ref, k_ref, v_ref, qseg, kseg, qpos, kpos,
+     (do_ref, lse_ref, delta_ref, dq_ref, dq_s)) = _unpack_refs(
+        has_seg, st.has_pos, refs)
 
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
-    bq = st.block_q
     scale = q_ref.shape[-1] ** -0.5
 
     @pl.when(ik == 0)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    q_max = iq * bq + bq - 1 + st.q_offset
-    run = (not st.causal) | (ik * st.block_kv <= q_max)
+    run = _block_run(st, iq, ik, qpos, kpos)
 
     @pl.when(run)
     def _body():
@@ -173,7 +209,7 @@ def _dq_kernel(st: _Statics, has_seg, *refs):
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
         z, t = _scaled_logits(st, q, k, scale)
-        mask = _block_mask(st, iq, ik, qseg, kseg)
+        mask = _block_mask(st, iq, ik, qseg, kseg, qpos, kpos)
         lse = lse_ref[0, 0][:, :1]                # [bq, 1] (lanes-broadcast)
         p = jnp.exp(z - lse) * mask.astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -193,19 +229,13 @@ def _dq_kernel(st: _Statics, has_seg, *refs):
 
 
 def _dkv_kernel(st: _Statics, has_seg, *refs):
-    if has_seg:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
-        qseg, kseg = qseg_ref, kseg_ref
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_s, dv_s) = refs
-        qseg = kseg = None
+    (q_ref, k_ref, v_ref, qseg, kseg, qpos, kpos,
+     (do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s)) = _unpack_refs(
+        has_seg, st.has_pos, refs)
 
     # grid = (batch, kv_head, kv_block, group, q_block)
     ik, g, iq = pl.program_id(2), pl.program_id(3), pl.program_id(4)
     ng, nq = pl.num_programs(3), pl.num_programs(4)
-    bq = st.block_q
     scale = q_ref.shape[-1] ** -0.5
 
     @pl.when((g == 0) & (iq == 0))
@@ -213,8 +243,7 @@ def _dkv_kernel(st: _Statics, has_seg, *refs):
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    q_max = iq * bq + bq - 1 + st.q_offset
-    run = (not st.causal) | (ik * st.block_kv <= q_max)
+    run = _block_run(st, iq, ik, qpos, kpos)
 
     @pl.when(run)
     def _body():
@@ -223,7 +252,7 @@ def _dkv_kernel(st: _Statics, has_seg, *refs):
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
         z, t = _scaled_logits(st, q, k, scale)
-        mask = _block_mask(st, iq, ik, qseg, kseg)
+        mask = _block_mask(st, iq, ik, qseg, kseg, qpos, kpos)
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(z - lse) * mask.astype(jnp.float32)
         dv_s[:] += jax.lax.dot_general(
@@ -256,7 +285,7 @@ def _seg_specs(Sq_p: int, Skv_p: int, batch_index):
     ]
 
 
-def _fwd_call(st: _Statics, q, k, v, qseg, kseg):
+def _fwd_call(st: _Statics, q, k, v, qseg, kseg, qpos=None, kpos=None):
     """q: [B,N,Sq,H]; k,v: [B,K,Skv,H] (padded) -> (o, lse[f32 B,N,Sq])."""
     B, N, Sq, H = q.shape
     K, Skv = k.shape[1], k.shape[2]
@@ -273,6 +302,9 @@ def _fwd_call(st: _Statics, q, k, v, qseg, kseg):
     if qseg is not None:
         in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
         args += [qseg, kseg]
+    if qpos is not None:
+        in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
+        args += [qpos, kpos]
 
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, st, qseg is not None),
@@ -300,7 +332,8 @@ def _fwd_call(st: _Statics, q, k, v, qseg, kseg):
     return out[0], out[1]
 
 
-def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do, g_lse=None):
+def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do, g_lse=None,
+              qpos=None, kpos=None):
     B, N, Sq, H = q.shape
     K, Skv = k.shape[1], k.shape[2]
     G = N // K
@@ -321,11 +354,16 @@ def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do, g_lse=None):
     row_spec4 = pl.BlockSpec(
         (1, 1, st.block_q, LANES), lambda b, n, iq, ik: (b, n, iq, 0)
     )
-    in_specs = [q_spec4, kv_spec4, kv_spec4, q_spec4, row_spec4, row_spec4]
-    args = [q, k, v, do, lse, delta]
+    in_specs = [q_spec4, kv_spec4, kv_spec4]
+    args = [q, k, v]
     if qseg is not None:
         in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
         args += [qseg, kseg]
+    if qpos is not None:
+        in_specs += _seg_specs(Sq, Skv, lambda b, n, iq, ik: (b, 0, 0))
+        args += [qpos, kpos]
+    in_specs += [q_spec4, row_spec4, row_spec4]
+    args += [do, lse, delta]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, st, qseg is not None),
@@ -351,11 +389,16 @@ def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do, g_lse=None):
         (1, 1, st.block_kv, H), lambda b, kh, ik, g, iq: (b, kh, ik, 0)
     )
     row_spec5 = pl.BlockSpec((1, 1, st.block_q, LANES), _row_map5)
-    in_specs5 = [q_spec5, kv_spec5, kv_spec5, q_spec5, row_spec5, row_spec5]
-    args5 = [q, k, v, do, lse, delta]
+    in_specs5 = [q_spec5, kv_spec5, kv_spec5]
+    args5 = [q, k, v]
     if qseg is not None:
         in_specs5 += _seg_specs(Sq, Skv, lambda b, kh, ik, g, iq: (b, 0, 0))
         args5 += [qseg, kseg]
+    if qpos is not None:
+        in_specs5 += _seg_specs(Sq, Skv, lambda b, kh, ik, g, iq: (b, 0, 0))
+        args5 += [qpos, kpos]
+    in_specs5 += [q_spec5, row_spec5, row_spec5]
+    args5 += [do, lse, delta]
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, st, qseg is not None),
@@ -376,45 +419,47 @@ def _bwd_call(st: _Statics, q, k, v, qseg, kseg, o, lse, do, g_lse=None):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash(st: _Statics, q, k, v, qseg, kseg):
-    o, _ = _fwd_call(st, q, k, v, qseg, kseg)
+def _flash(st: _Statics, q, k, v, qseg, kseg, qpos, kpos):
+    o, _ = _fwd_call(st, q, k, v, qseg, kseg, qpos, kpos)
     return o
 
 
-def _flash_fwd(st, q, k, v, qseg, kseg):
-    o, lse = _fwd_call(st, q, k, v, qseg, kseg)
-    return o, (q, k, v, qseg, kseg, o, lse)
+def _flash_fwd(st, q, k, v, qseg, kseg, qpos, kpos):
+    o, lse = _fwd_call(st, q, k, v, qseg, kseg, qpos, kpos)
+    return o, (q, k, v, qseg, kseg, qpos, kpos, o, lse)
 
 
 def _flash_bwd(st, res, do):
-    q, k, v, qseg, kseg, o, lse = res
-    dq, dk, dv = _bwd_call(st, q, k, v, qseg, kseg, o, lse, do)
-    return dq, dk, dv, None, None
+    q, k, v, qseg, kseg, qpos, kpos, o, lse = res
+    dq, dk, dv = _bwd_call(st, q, k, v, qseg, kseg, o, lse, do,
+                           qpos=qpos, kpos=kpos)
+    return dq, dk, dv, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash_lse(st: _Statics, q, k, v, qseg, kseg):
+def _flash_lse(st: _Statics, q, k, v, qseg, kseg, qpos, kpos):
     """Like _flash but also returns the lanes-broadcast lse residual as a
     differentiable output (ring attention's block merge needs it)."""
-    return _fwd_call(st, q, k, v, qseg, kseg)
+    return _fwd_call(st, q, k, v, qseg, kseg, qpos, kpos)
 
 
-def _flash_lse_fwd(st, q, k, v, qseg, kseg):
-    o, lse = _fwd_call(st, q, k, v, qseg, kseg)
-    return (o, lse), (q, k, v, qseg, kseg, o, lse)
+def _flash_lse_fwd(st, q, k, v, qseg, kseg, qpos, kpos):
+    o, lse = _fwd_call(st, q, k, v, qseg, kseg, qpos, kpos)
+    return (o, lse), (q, k, v, qseg, kseg, qpos, kpos, o, lse)
 
 
 def _flash_lse_bwd(st, res, cts):
-    q, k, v, qseg, kseg, o, lse = res
+    q, k, v, qseg, kseg, qpos, kpos, o, lse = res
     do, dlse = cts
     # The primal lse output is lanes-broadcast [B, N, Sq, LANES]; the true
     # scalar-per-row cotangent is the sum over the broadcast lane copies.
     g_lse = dlse.sum(axis=-1)
-    dq, dk, dv = _bwd_call(st, q, k, v, qseg, kseg, o, lse, do, g_lse=g_lse)
-    return dq, dk, dv, None, None
+    dq, dk, dv = _bwd_call(st, q, k, v, qseg, kseg, o, lse, do, g_lse=g_lse,
+                           qpos=qpos, kpos=kpos)
+    return dq, dk, dv, None, None, None, None
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -433,19 +478,23 @@ def flash_attention_with_lse(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention returning ``(out, lse)``; the blockwise unit of ring
     attention (parallel/sequence.py merges partial outputs via their lse).
 
     out: [B, Sq, N, H] in q.dtype; lse: [B, N, Sq] float32, ``-inf`` on rows
     where nothing was attended (fully masked). Differentiable in both
-    outputs.
+    outputs. ``q_positions``/``kv_positions`` as in ``flash_attention``
+    (striped ring layouts pass the stripes' global positions).
     """
-    st, qt, kt, vt, qseg, kseg, Sq = _prep(
+    st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq = _prep(
         q, k, v, q_segment_ids, kv_segment_ids,
         causal, logit_softcap, q_offset, block_q, block_kv, interpret,
+        q_positions, kv_positions,
     )
-    o, lse = _flash_lse(st, qt, kt, vt, qseg, kseg)
+    o, lse = _flash_lse(st, qt, kt, vt, qseg, kseg, qpos, kpos)
     o = o[:, :, :Sq, :].transpose(0, 2, 1, 3)
     lse = lse[:, :, :Sq, 0]
     # In-kernel "nothing attended" rows carry the finite NEG_INF stand-in;
@@ -454,9 +503,15 @@ def flash_attention_with_lse(
     return o, lse
 
 
+PAD_POS_KV = 2 ** 30  # kv-position pad: larger than any real position, so
+#                       padded columns never pass the >= causal test and
+#                       fully-padded blocks are skippable by min().
+
+
 def _prep(
     q, k, v, q_segment_ids, kv_segment_ids,
     causal, logit_softcap, q_offset, block_q, block_kv, interpret,
+    q_positions=None, kv_positions=None,
 ):
     """Shared wrapper prep: statics + [B,N,S,H] transpose + block padding.
 
@@ -466,6 +521,7 @@ def _prep(
     the conservative 128x128 was ~2x *slower* than xla.
     """
     assert (q_segment_ids is None) == (kv_segment_ids is None)
+    assert (q_positions is None) == (kv_positions is None)
     B, Sq, N, H = q.shape
     Skv, K = k.shape[1], k.shape[2]
     assert N % K == 0, (N, K)
@@ -482,6 +538,7 @@ def _prep(
         block_q=bq,
         block_kv=bk,
         interpret=resolve_interpret(interpret),
+        has_pos=q_positions is not None,
     )
 
     qt = pad_axis(q.transpose(0, 2, 1, 3), 2, Sq_p)
@@ -492,7 +549,22 @@ def _prep(
         # (B, 1, S) so the full-seq segment blocks are TPU tiling-legal.
         qseg = pad_axis(q_segment_ids.astype(jnp.int32), 1, Sq_p)[:, None, :]
         kseg = pad_axis(kv_segment_ids.astype(jnp.int32), 1, Skv_p)[:, None, :]
-    return st, qt, kt, vt, qseg, kseg, Sq
+    qpos = kpos = None
+    if q_positions is not None:
+        if q_positions.ndim == 1:
+            q_positions = jnp.broadcast_to(q_positions[None], (B, Sq))
+        if kv_positions.ndim == 1:
+            kv_positions = jnp.broadcast_to(kv_positions[None], (B, Skv))
+        # q pad -1 (rows sliced off; never attends under >=), kv pad huge
+        # (never attended; keeps fully-padded blocks skippable).
+        qpos = pad_axis(
+            q_positions.astype(jnp.int32) + 1, 1, Sq_p
+        )[:, None, :] - 1
+        kpos = jnp.pad(
+            kv_positions.astype(jnp.int32), ((0, 0), (0, Skv_p - Skv)),
+            constant_values=PAD_POS_KV,
+        )[:, None, :]
+    return st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq
 
 
 def flash_attention(
@@ -508,15 +580,21 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention; shapes/semantics match ``attention_xla``.
 
     q: [B, Sq, N, H]; k, v: [B, Skv, K, H] with N % K == 0 -> [B, Sq, N, H].
+    With ``q_positions``/``kv_positions`` ([B, S] or [S] int32), causal
+    masking compares those explicit positions (permuted/striped sequence
+    layouts); otherwise token index + ``q_offset``.
     See ``_prep`` for the tile-size default rationale.
     """
-    st, qt, kt, vt, qseg, kseg, Sq = _prep(
+    st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq = _prep(
         q, k, v, q_segment_ids, kv_segment_ids,
         causal, logit_softcap, q_offset, block_q, block_kv, interpret,
+        q_positions, kv_positions,
     )
-    o = _flash(st, qt, kt, vt, qseg, kseg)
+    o = _flash(st, qt, kt, vt, qseg, kseg, qpos, kpos)
     return o[:, :, :Sq, :].transpose(0, 2, 1, 3)

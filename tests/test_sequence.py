@@ -20,7 +20,7 @@ def _qkv(key, b=2, s=64, n=8, k_heads=8, h=16, dtype=jnp.float32):
     return q, k, v
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 @pytest.mark.parametrize("causal", [True, False])
 def test_sp_matches_reference(cpu_devices, method, causal):
     mesh = make_mesh(cpu_devices, sp=8)
@@ -34,7 +34,7 @@ def test_sp_matches_reference(cpu_devices, method, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 def test_sp_gqa(cpu_devices, method):
     mesh = make_mesh(cpu_devices, sp=8)
     q, k, v = _qkv(jax.random.key(1), n=8, k_heads=8 if method == "ulysses" else 2)
@@ -53,7 +53,7 @@ def test_ulysses_gqa_kv_replication(cpu_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 def test_sp_segment_ids(cpu_devices, method):
     mesh = make_mesh(cpu_devices, sp=8)
     q, k, v = _qkv(jax.random.key(2))
@@ -76,7 +76,7 @@ def test_ring_softcap(cpu_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 def test_sp_composes_with_dp(cpu_devices, method):
     mesh = make_mesh(cpu_devices, dp=2, sp=4)
     q, k, v = _qkv(jax.random.key(4), b=4)
@@ -93,7 +93,7 @@ def test_ring_composes_with_tp(cpu_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 def test_sp_gradients_match(cpu_devices, method):
     mesh = make_mesh(cpu_devices, sp=8)
     q, k, v = _qkv(jax.random.key(6))
@@ -178,6 +178,36 @@ def test_ring_pallas_gradients_match(cpu_devices):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
 
 
+def test_ring_striped_pallas_kernel_and_grads(cpu_devices):
+    """Striped ring with the flash kernel: the stripes' global positions
+    flow into the kernel's position-based causal mask, fwd and grads."""
+    mesh = make_mesh(cpu_devices, sp=4)
+    q, k, v = _qkv(jax.random.key(13), s=256, n=8, k_heads=2, h=64)
+
+    def loss_ref(q, k, v):
+        return (attention_xla(q, k, v, causal=True) ** 2).sum()
+
+    def loss_sp(q, k, v):
+        out = sequence_attention(
+            q, k, v, mesh, method="ring_striped", causal=True,
+            impl="pallas_interpret",
+        )
+        return (out ** 2).sum()
+
+    out = jax.jit(
+        lambda q, k, v: sequence_attention(
+            q, k, v, mesh, method="ring_striped", impl="pallas_interpret"
+        )
+    )(q, k, v)
+    ref = attention_xla(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_sp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
 def test_ulysses_rejects_bad_heads(cpu_devices):
     mesh = make_mesh(cpu_devices, sp=8)
     q, k, v = _qkv(jax.random.key(7), n=4, k_heads=2)  # 4 heads, sp=8
@@ -185,7 +215,7 @@ def test_ulysses_rejects_bad_heads(cpu_devices):
         sequence_attention(q, k, v, mesh, method="ulysses")
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
+@pytest.mark.parametrize("method", ["ring", "ring_striped", "ulysses"])
 def test_trainer_sp_equivalence(cpu_devices, method, tmp_path):
     """Cross-layout equivalence (SURVEY.md §5): sp-sharded training produces
     the same losses as single-device training on the same data and seed."""
