@@ -20,8 +20,10 @@ from orion_tpu.comm.collectives import (
     reduce_scatter,
     ring_shift,
 )
+from orion_tpu.comm.quantized import quantized_all_reduce
 
 __all__ = [
+    "quantized_all_reduce",
     "all_gather",
     "all_reduce",
     "all_to_all",

@@ -1,0 +1,108 @@
+"""Block-quantized all-reduce (EQuARX-class; PAPERS.md:5).
+
+The reference's NCCL all-reduce moves gradients at full precision; EQuARX
+shows the wire traffic can ride int8 with per-block scales at negligible
+quality cost — the win is largest where bandwidth is scarcest (DCN links
+between slices, exactly where the hybrid mesh places the ``dp`` axis;
+``runtime/mesh.py`` ``dcn_axes``).
+
+XLA owns the collective schedule, so unlike NCCL we cannot quantize each
+ring hop. Instead this is the two-phase quantized exchange: both phases
+move int8 payloads (plus float32 per-block scales, ``1/block`` overhead),
+and the reduction itself happens in float32 on-device:
+
+    phase 1  all_to_all   int8 shards + scales  -> each device holds every
+             peer's copy of its 1/n slice; dequantize, sum in f32
+             (a reduce-scatter with quantized wire format)
+    phase 2  all_gather   int8 reduced slice + scales -> dequantize
+             (an all-gather with quantized wire format)
+
+Wire bytes ~ (2/n + 2) * size vs ``psum``'s 2 * (n-1)/n * 2 * size for
+bf16 — a ~2x reduction vs bf16, ~4x vs f32, at an error bounded by one
+quantization step per phase (amax/127 per block, two phases).
+
+Usable only inside ``shard_map`` manual over ``axis``, like every wrapper
+in ``comm.collectives``. The trainer exposes it for pure-DP gradient
+reduction via ``train.grad_quant_bits=8`` (see ``train/trainer.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from orion_tpu.comm.collectives import Axis
+
+_INT8_MAX = 127.0
+
+
+def _quantize(x: jax.Array, block: int) -> tuple[jax.Array, jax.Array]:
+    """Flat f32 [m*block] -> (int8 [m*block], f32 scales [m])."""
+    blocks = x.reshape(-1, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = amax / _INT8_MAX
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(blocks * inv), -_INT8_MAX, _INT8_MAX)
+    return q.astype(jnp.int8).reshape(-1), scale[:, 0]
+
+
+def _dequantize(q: jax.Array, scale: jax.Array, block: int) -> jax.Array:
+    return (
+        q.reshape(-1, block).astype(jnp.float32) * scale[:, None]
+    ).reshape(-1)
+
+
+def quantized_all_reduce(
+    x: jax.Array,
+    axis: Axis,
+    *,
+    block: int = 256,
+    mean: bool = False,
+) -> jax.Array:
+    """Sum (or mean) ``x`` across ``axis`` with int8 wire traffic.
+
+    Per-phase error is bounded by half a quantization step per element
+    (amax_block / 254); the reduction itself is exact f32. Scalars and
+    tiny arrays (< one block per device) skip quantization — the wire
+    saving is nil and the relative error is worst there.
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    size = x.size
+    if size < n * block:
+        red = lax.psum(x, axis)
+        return red / n if mean else red
+
+    flat = x.astype(jnp.float32).reshape(-1)
+    # Pad so every device's slice is a whole number of blocks.
+    slice_elems = -(-size // (n * block)) * block
+    pad = n * slice_elems - size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+
+    # Phase 1: quantize locally, exchange slices, reduce own slice in f32.
+    q, s = _quantize(flat, block)
+    q = q.reshape(n, slice_elems)
+    s = s.reshape(n, slice_elems // block)
+    # all_to_all with a leading device dim: device d receives stacked
+    # [n, slice] = every peer's copy of slice d.
+    q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    s_recv = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+    q_recv = q_recv.reshape(n, slice_elems)
+    s_recv = s_recv.reshape(n, slice_elems // block)
+    reduced = jax.vmap(_dequantize, in_axes=(0, 0, None))(
+        q_recv, s_recv, block
+    ).sum(axis=0)
+    if mean:
+        reduced = reduced / n
+
+    # Phase 2: quantize the reduced slice, gather all slices.
+    q2, s2 = _quantize(reduced, block)
+    q_all = lax.all_gather(q2, axis, axis=0, tiled=True)
+    s_all = lax.all_gather(s2, axis, axis=0, tiled=True)
+    out = _dequantize(q_all, s_all, block)
+    if pad:
+        out = out[:size]
+    return out.reshape(x.shape).astype(x.dtype)

@@ -90,6 +90,12 @@ class ModelConfig:
     # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
     remat: str = "none"
 
+    # Stream the LM-head projection + cross-entropy over sequence chunks of
+    # this size (must divide seq_len) instead of materializing the full
+    # [B, S, V] float32 logits. None => dense loss. Cuts the peak activation
+    # by ~2x(S/chunk) GiB-scale at large vocab; backward remats per chunk.
+    loss_chunk: Optional[int] = None
+
     # Layers are evaluated with lax.scan over stacked per-layer params.
     scan_layers: bool = True
 
@@ -244,6 +250,11 @@ class TrainConfig:
     # Device peak bf16 FLOP/s for MFU; None => autodetect from device kind.
     peak_flops_per_device: Optional[float] = None
     metrics_jsonl: Optional[str] = None
+    # Quantize the data-parallel gradient all-reduce wire traffic to int8
+    # with per-block scales (EQuARX-class; comm/quantized.py). Only valid
+    # with pure DP (fsdp=tp=pp=sp=ep=1) — the bandwidth win targets the
+    # DCN-crossing dp axis of hybrid meshes. None => full-precision psum.
+    grad_quant_bits: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -311,11 +322,20 @@ def _parse_value(raw: str, target_type: Any) -> Any:
         non_none = [a for a in typing.get_args(target_type) if a is not type(None)]
         return _parse_value(raw, non_none[0])
     if origin is tuple or target_type is tuple:
+        # Accept "(5,7)", "[5,7]", "5,7", and quoted-string forms like
+        # '("dp",)'; elements are auto-typed (int/float/str).
+        raw = raw.strip()
+        if raw.startswith("(") and raw.endswith(")"):
+            raw = raw[1:-1]
         if not raw:
             return ()
         if raw.startswith("["):
             return tuple(json.loads(raw))
-        return tuple(_auto(v) for v in raw.split(","))
+        return tuple(
+            _auto(v.strip().strip("'\""))
+            for v in raw.split(",")
+            if v.strip()  # tolerate the trailing comma of 1-tuples
+        )
     if target_type is bool:
         return raw.lower() in ("1", "true", "yes", "on")
     if target_type is int:

@@ -198,6 +198,13 @@ class InferenceEngine:
         # Pass 1 (host): claim slots + pages for every admissible request,
         # preserving arrival order (head-of-line blocking on resources).
         admitted: list[tuple[Request, int]] = []
+        # Headroom pages claimed by this burst's earlier admissions but not
+        # yet allocated (they materialize in _grow_pages): without carrying
+        # this across the loop, N admissions each pass the check against the
+        # same free pool and the burst over-commits — _grow_pages then
+        # preempts an OLDER request in the same step, discarding its
+        # just-done prefill.
+        reserved = 0
         while self.waiting:
             req = self.waiting[0]
             slot = next(
@@ -217,8 +224,10 @@ class InferenceEngine:
                 self.icfg.max_seq_len - 1,
             )
             first_window = min(last // self.psz + 1, self.pages_per_seq)
-            if self.alloc.free_pages < max(n_pages + 1, first_window):
+            need = max(n_pages + 1, first_window)
+            if self.alloc.free_pages - reserved < need:
                 break  # head-of-line blocking: keep arrival order
+            reserved += need - n_pages
             self.waiting.popleft()
             req.slot = slot
             req.admit_seq = next(self._admit_seq)

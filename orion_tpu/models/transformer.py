@@ -355,6 +355,35 @@ def forward(
     mesh: Optional[Any] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """tokens: [B, S] int32 -> (logits [B, S, V] float32, moe_aux scalar)."""
+    x, moe_aux = _hidden_states(
+        params,
+        tokens,
+        cfg,
+        positions=positions,
+        segment_ids=segment_ids,
+        mesh=mesh,
+    )
+    with jax.named_scope("unembed"):
+        logits = unembed(params, x, cfg)
+    return logits, moe_aux
+
+
+def _hidden_states(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    mesh: Optional[Any] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """The block-stack output [B, S, D] before final norm / LM head.
+
+    Same trace as ``forward`` minus ``unembed``; split out so the chunked
+    loss can stream the vocab projection instead of materializing the full
+    [B, S, V] float32 logits (the single largest activation at training
+    shapes — ~2 GiB at the bench config).
+    """
     B, S = tokens.shape
     custom_positions = positions is not None
     if positions is None:
@@ -366,8 +395,6 @@ def forward(
     def block_fn(carry, bp):
         pos = positions
         if pos.shape[0] != carry.shape[0]:
-            # Pipeline microbatches are [mb, S, D] with mb < B; positions are
-            # batch-uniform there (validated below), so row 0 serves all.
             pos = jnp.broadcast_to(pos[:1], (carry.shape[0], pos.shape[1]))
         y, aux = _block(carry, bp, cfg, pos, segment_ids, mesh)
         return y, aux
@@ -411,10 +438,7 @@ def forward(
         for bp in params["blocks"]:
             x, aux = block_fn(x, bp)
             moe_aux = moe_aux + aux
-
-    with jax.named_scope("unembed"):
-        logits = unembed(params, x, cfg)
-    return logits, moe_aux
+    return x, moe_aux
 
 
 def loss_fn(
@@ -427,8 +451,44 @@ def loss_fn(
 
     batch: inputs [B,S], targets [B,S], optional loss_mask [B,S] (1 = count),
     optional segment_ids/positions for packed sequences.
+
+    With ``cfg.loss_chunk`` set, the vocab projection + softmax stream over
+    sequence chunks under remat, so the full [B, S, V] float32 logits (the
+    single largest training activation — ~2 GiB at the bench shapes, x2 for
+    log_softmax, live into the backward) are never materialized; peak vocab
+    memory drops to [B, chunk, V] per direction. The chunked and dense paths
+    are the same math (logsumexp - target logit) and are parity-tested.
     """
-    logits, moe_aux = forward(
+    targets = batch["targets"]
+    mask = batch.get("loss_mask")
+    chunk = cfg.loss_chunk
+    S = targets.shape[1]
+    if chunk and S % chunk:
+        # Refuse rather than silently materialize the dense logits the knob
+        # exists to avoid (the config documents the divisibility contract).
+        raise ValueError(
+            f"model.loss_chunk={chunk} must divide seq_len={S}"
+        )
+    if not chunk or S == chunk:
+        logits, moe_aux = forward(
+            params,
+            batch["inputs"],
+            cfg,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            mesh=mesh,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is None:
+            mask = jnp.ones_like(nll)
+        mask = mask.astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        ce = (nll * mask).sum() / denom
+        loss = ce + cfg.router_aux_loss_weight * moe_aux
+        return loss, {"ce_loss": ce, "moe_aux": moe_aux, "tokens": denom}
+
+    x, moe_aux = _hidden_states(
         params,
         batch["inputs"],
         cfg,
@@ -436,13 +496,33 @@ def loss_fn(
         segment_ids=batch.get("segment_ids"),
         mesh=mesh,
     )
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
+    B = targets.shape[0]
     if mask is None:
-        mask = jnp.ones_like(nll)
+        mask = jnp.ones((B, S), jnp.float32)
     mask = mask.astype(jnp.float32)
-    denom = jnp.maximum(mask.sum(), 1.0)
-    ce = (nll * mask).sum() / denom
+    n_chunks = S // chunk
+
+    def to_chunks(a):
+        # [B, S, ...] -> [n_chunks, B, chunk, ...] scan-leading layout.
+        return a.reshape(B, n_chunks, chunk, *a.shape[2:]).swapaxes(0, 1)
+
+    def ce_chunk(carry, xs):
+        xc, tc, mc = xs
+        with jax.named_scope("unembed_chunk"):
+            logits = unembed(params, xc, cfg)  # [B, chunk, V] float32
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        nll_sum = ((logz - tgt) * mc).sum()
+        return (carry[0] + nll_sum, carry[1] + mc.sum()), None
+
+    # Remat per chunk: the backward recomputes one chunk of logits at a
+    # time instead of keeping them all live.
+    (nll_total, mask_total), _ = jax.lax.scan(
+        jax.checkpoint(ce_chunk),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (to_chunks(x), to_chunks(targets), to_chunks(mask)),
+    )
+    denom = jnp.maximum(mask_total, 1.0)
+    ce = nll_total / denom
     loss = ce + cfg.router_aux_loss_weight * moe_aux
     return loss, {"ce_loss": ce, "moe_aux": moe_aux, "tokens": denom}

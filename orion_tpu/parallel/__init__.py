@@ -18,6 +18,7 @@ from orion_tpu.parallel.sharding import (
     shard_init,
 )
 from orion_tpu.parallel.pipeline import pipeline_forward
+from orion_tpu.parallel.reshard import reshard
 from orion_tpu.parallel.sequence import (
     ring_attention,
     sequence_attention,
@@ -31,6 +32,7 @@ __all__ = [
     "param_shardings",
     "shard_init",
     "pipeline_forward",
+    "reshard",
     "ring_attention",
     "sequence_attention",
     "ulysses_attention",

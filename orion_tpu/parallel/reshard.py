@@ -1,0 +1,54 @@
+"""Cross-layout array redistribution (PAPERS.md:8).
+
+The reference world reshapes a training job's parallelism by tearing down
+one NCCL process group and hand-coding gather/scatter into the next layout;
+the portable-collectives paper (PAPERS.md:8) frames redistribution as a
+first-class operation. On TPU the whole problem collapses into sharding
+annotations: XLA already knows how to move any `NamedSharding` layout to any
+other with a minimal collective schedule (all-to-all / collective-permute
+over ICI), so redistribution is one `device_put` (eager) or an identity jit
+with `out_shardings` (compiled, fusable with surrounding work).
+
+Two consumers:
+  - live layout migration: `reshard(state, new_shardings)` moves a training
+    state between e.g. fsdp- and tp-major layouts without a checkpoint
+    round-trip (tests/test_parallel.py cross-layout tests);
+  - checkpoint portability: Orbax restores directly into *any* target
+    layout via the abstract-state template (`Trainer.abstract_state`), so a
+    checkpoint written under one parallelism config restores under another
+    with no conversion step (tests/test_train.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def reshard(tree: Any, target_shardings: Any, *, donate: bool = False) -> Any:
+    """Redistribute every array in ``tree`` to ``target_shardings``.
+
+    ``target_shardings`` is a matching pytree of ``jax.sharding.Sharding``s
+    (build one with ``parallel.param_shardings`` / ``train.state_shardings``
+    over the destination mesh). The result never aliases the source
+    (``may_alias=False``): a leaf whose layout already matches would
+    otherwise share buffers, and a later donating step on the source state
+    (every train step donates) would delete it out from under the migrated
+    copy. With ``donate=True`` the source buffers are consumed instead —
+    pass it when migrating a state the caller won't touch again (halves
+    peak memory for same-mesh moves).
+    """
+    flat_t, treedef_t = jax.tree.flatten(tree)
+    flat_s, treedef_s = jax.tree.flatten(
+        target_shardings,
+        is_leaf=lambda x: isinstance(x, jax.sharding.Sharding),
+    )
+    if treedef_t != treedef_s:
+        raise ValueError(
+            f"tree/shardings structure mismatch: {treedef_t} vs {treedef_s}"
+        )
+    out = jax.device_put(
+        flat_t, flat_s, donate=donate, may_alias=False if not donate else None
+    )
+    return jax.tree.unflatten(treedef_t, out)

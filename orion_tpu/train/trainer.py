@@ -134,10 +134,77 @@ def make_train_step(
         }
         return loss * inv, aux, grads
 
+    quant_bits = cfg.train.grad_quant_bits
+    if quant_bits:
+        # Int8-wire DP gradient reduction (EQuARX-class; comm/quantized.py).
+        # Grads are computed per-dp-shard inside a shard_map manual over dp
+        # only, reduced with quantized collectives, and returned replicated.
+        # Pure DP is required: with the other axes at 1 the model forward
+        # contains no cross-device collectives of its own, so the manual dp
+        # region is self-contained.
+        from jax import lax as _lax
+
+        from orion_tpu.comm.quantized import quantized_all_reduce
+
+        if quant_bits != 8:
+            raise ValueError(f"grad_quant_bits={quant_bits}; only 8 works")
+        others = {
+            k: v
+            for k, v in (mesh.shape.items() if mesh is not None else [])
+            if k != "dp" and v > 1
+        }
+        if others:
+            raise ValueError(
+                f"grad_quant_bits needs pure DP; mesh has {others}"
+            )
+
+        def reduced_loss_and_grads(params, batch):
+            if "loss_mask" in batch:
+                # The combined ce+moe gradient cannot be re-weighted by
+                # per-shard valid-token counts after the fact, so a uniform
+                # pmean would bias shards with few valid tokens. Masked /
+                # packed batches need the exact (full-precision, XLA-
+                # inserted) reduction.
+                raise ValueError(
+                    "train.grad_quant_bits does not support loss_mask "
+                    "batches: dp shards with unequal valid-token counts "
+                    "need token-weighted reduction; use full-precision"
+                )
+
+            def body(params, batch):
+                loss, aux, grads = loss_and_grads(params, batch)
+                grads = jax.tree.map(
+                    lambda g: quantized_all_reduce(g, "dp", mean=True), grads
+                )
+                loss = _lax.pmean(loss, "dp")
+                aux = {
+                    k: _lax.psum(v, "dp")
+                    if k == "tokens"
+                    else _lax.pmean(v, "dp")
+                    for k, v in aux.items()
+                }
+                return loss, aux, grads
+
+            bspec = P(None, "dp") if accum > 1 else P("dp")
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(
+                    jax.tree.map(lambda _: P(), params),
+                    jax.tree.map(lambda _: bspec, batch),
+                ),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )(params, batch)
+
+        grads_fn = reduced_loss_and_grads
+    else:
+        grads_fn = loss_and_grads
+
     def train_step(state: TrainState, batch):
         params = state["params"]
         with jax.named_scope("fwd_bwd"):
-            loss, aux, grads = loss_and_grads(params, batch)
+            loss, aux, grads = grads_fn(params, batch)
         lr = schedule(state["opt"]["count"]).astype(jnp.float32)
         with jax.named_scope("optimizer"):
             new_params, new_opt, opt_metrics = apply_updates(
@@ -223,6 +290,15 @@ class Trainer:
             raise ValueError(
                 f"grad_accum={cfg.train.grad_accum} must divide global batch "
                 f"{cfg.data.batch_size}"
+            )
+        micro = cfg.data.batch_size // max(cfg.train.grad_accum, 1)
+        dpf = cfg.parallel.dp * cfg.parallel.fsdp
+        if micro % dpf:
+            raise ValueError(
+                f"per-step batch {micro} (data.batch_size="
+                f"{cfg.data.batch_size} / grad_accum="
+                f"{max(cfg.train.grad_accum, 1)}) must be divisible by "
+                f"dp*fsdp={dpf}"
             )
         initialize(cfg.runtime)
         self.mesh = build_mesh(cfg.parallel, platform=cfg.runtime.platform)

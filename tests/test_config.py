@@ -85,3 +85,24 @@ def test_config_json_roundtrip():
     cfg = get_config("tiny")
     s = cfg.to_json()
     assert '"n_layers": 2' in s
+
+
+def test_tuple_override_forms():
+    """Tuple overrides accept python-repr, bare, and json forms; elements
+    are typed (the '(5,7)' form previously parsed to ('(5', '7)') strings,
+    silently disabling train.profile_steps)."""
+    from orion_tpu.config import get_config
+
+    for ov, want in [
+        ("train.profile_steps=(5,7)", (5, 7)),
+        ("train.profile_steps=5,7", (5, 7)),
+        ("train.profile_steps=[5,7]", (5, 7)),
+        ("train.profile_steps=none", None),
+    ]:
+        assert get_config("tiny", [ov]).train.profile_steps == want, ov
+    for ov, want in [
+        ('parallel.dcn_axes=("dp",)', ("dp",)),
+        ("parallel.dcn_axes=dp", ("dp",)),
+        ("parallel.dcn_axes=dp,fsdp", ("dp", "fsdp")),
+    ]:
+        assert get_config("tiny", [ov]).parallel.dcn_axes == want, ov

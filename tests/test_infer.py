@@ -231,3 +231,25 @@ def test_sample_top_p_restricts_support():
     for s in range(20):
         t = sample(logits, jax.random.key(s), temperature=1.0, top_p=0.9)
         assert int(t[0]) in (0, 1)
+
+
+def test_admission_burst_reserves_decode_headroom():
+    """A multi-request admission burst must account for every admitted
+    request's first-decode-window headroom cumulatively: over-committing let
+    _grow_pages preempt the OLDEST request in the very step it prefilled
+    (discarding its work). With the reservation, the second request simply
+    waits and nobody is preempted."""
+    cfg, params = _setup(overrides=[
+        "inference.num_pages=8",        # 7 usable; first_window=5 per req
+        "inference.decode_window=64",
+        "inference.max_new_tokens=8",
+    ])
+    eng = InferenceEngine(cfg, params)
+    prompts = [[(i * 7 + j) % 250 + 1 for j in range(16)] for i in range(2)]
+    refs = [_ref_generate(params, cfg.model, p, 8) for p in prompts]
+    outs = eng.generate(prompts, 8)
+    assert outs == refs
+    assert eng.preemptions == 0, (
+        f"admission burst over-committed the pool ({eng.preemptions} "
+        "preemptions)"
+    )

@@ -174,3 +174,62 @@ def test_build_mesh_hybrid_path(cpu_devices, monkeypatch):
     assert seen["dcn"] == (1, 2, 1, 1, 1, 1)
     assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1,
                                 "sp": 1, "tp": 2}
+
+
+# -- quantized all-reduce (EQuARX-class; comm/quantized.py) -------------------
+
+
+def _qar(mesh, x, n_shards, **kw):
+    f = shard_map(
+        lambda v: comm.quantized_all_reduce(v[0], "dp", **kw)[None],
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_specs=P("dp"),
+        check_vma=False,
+    )
+    return f(x)
+
+
+def test_quantized_all_reduce_matches_psum(mesh8):
+    # Per-device [8, 4096] values; compare the int8-wire sum to exact psum.
+    x = jax.random.normal(jax.random.key(0), (8, 4096)) * jnp.exp(
+        jax.random.normal(jax.random.key(1), (8, 1))  # varied block scales
+    )
+    out = np.asarray(_qar(mesh8, x, 8))
+    exact = np.asarray(x).sum(0)
+    # Every device got the same reduced value.
+    for i in range(1, 8):
+        np.testing.assert_array_equal(out[i], out[0])
+    # Error bound: one int8 step per phase; check relative to block amax.
+    err = np.abs(out[0] - exact)
+    tol = 3.0 * np.abs(np.asarray(x)).max() / 127.0
+    assert err.max() < tol, (err.max(), tol)
+    # And meaningfully accurate overall.
+    rel = np.linalg.norm(out[0] - exact) / np.linalg.norm(exact)
+    assert rel < 2e-2, rel
+
+
+def test_quantized_all_reduce_small_and_odd_shapes(mesh8):
+    # Scalars / tiny arrays fall back to exact psum; odd sizes are padded.
+    for shape in ((), (3,), (37, 5), (8191,)):
+        x = jax.random.normal(jax.random.key(2), (8, *shape))
+        out = np.asarray(_qar(mesh8, x, 8))
+        exact = np.asarray(x).sum(0)
+        if x[0].size < 8 * 256:
+            np.testing.assert_allclose(out[0], exact, rtol=1e-6, atol=1e-6)
+        else:
+            rel = np.linalg.norm(out[0] - exact) / np.linalg.norm(exact)
+            assert rel < 2e-2, (shape, rel)
+
+
+def test_quantized_all_reduce_mean(mesh8):
+    x = jnp.ones((8, 4096)) * jnp.arange(1.0, 9.0)[:, None]
+    out = np.asarray(_qar(mesh8, x, 8, mean=True))
+    np.testing.assert_allclose(out[0], np.full(4096, 4.5), rtol=1e-2)
+
+
+def test_quantized_all_reduce_axis_size_one(cpu_devices):
+    mesh = make_mesh(cpu_devices, dp=1)
+    x = jnp.arange(4096.0)[None]
+    out = np.asarray(_qar(mesh, x, 1))
+    np.testing.assert_array_equal(out[0], np.asarray(x[0]))

@@ -111,6 +111,50 @@ def test_remat_matches_no_remat():
     )
 
 
+def test_chunked_loss_matches_dense():
+    """loss_chunk streams the vocab projection; same loss + grads as dense."""
+    cfg = get_config("tiny-llama").model
+    cfg_c = get_config("tiny-llama", ["model.loss_chunk=4"]).model
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    mask = (jax.random.uniform(jax.random.key(2), (2, 16)) > 0.3).astype(
+        jnp.float32
+    )
+    batch = {"inputs": tokens, "targets": tokens, "loss_mask": mask}
+    (l1, aux1), g1 = jax.value_and_grad(
+        lambda p: loss_fn(p, batch, cfg), has_aux=True
+    )(params)
+    (l2, aux2), g2 = jax.value_and_grad(
+        lambda p: loss_fn(p, batch, cfg_c), has_aux=True
+    )(params)
+    assert float(l1) == pytest.approx(float(l2), abs=1e-5)
+    assert float(aux1["tokens"]) == float(aux2["tokens"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        g1,
+        g2,
+    )
+
+
+def test_chunked_loss_non_dividing_raises():
+    """A chunk that doesn't divide seq_len must refuse, not silently fall
+    back to the dense logits the knob exists to avoid."""
+    cfg_c = get_config("tiny-llama", ["model.loss_chunk=5"]).model
+    cfg = get_config("tiny-llama").model
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab_size)
+    batch = {"inputs": tokens, "targets": tokens}
+    with pytest.raises(ValueError, match="must divide seq_len"):
+        loss_fn(params, batch, cfg_c)
+    # chunk == seq_len is the dense path by construction and stays allowed.
+    cfg_eq = get_config("tiny-llama", ["model.loss_chunk=16"]).model
+    l1, _ = loss_fn(params, batch, cfg)
+    l2, _ = loss_fn(params, batch, cfg_eq)
+    assert float(l1) == pytest.approx(float(l2), abs=1e-6)
+
+
 def test_rope_properties():
     # Rotation preserves norms; position 0 is identity.
     x = jax.random.normal(jax.random.key(0), (1, 6, 2, 8))

@@ -52,6 +52,57 @@ def test_moe_ep_matches_single_device():
         np.testing.assert_allclose(l.loss, b.loss, rtol=5e-3, atol=5e-3)
 
 
+def test_quantized_grad_reduce_tracks_exact(single_device_baseline):
+    """DP with int8-wire gradient all-reduce (train.grad_quant_bits=8;
+    comm/quantized.py) must track the exact-reduction loss trajectory to
+    quantization tolerance."""
+    quant = _run("tiny-llama", 4, "parallel.dp=8", "train.grad_quant_bits=8")
+    for b, l in zip(single_device_baseline, quant):
+        np.testing.assert_allclose(l.loss, b.loss, rtol=2e-2, atol=2e-2)
+
+
+def test_quantized_grad_reduce_rejects_model_sharding():
+    from orion_tpu.config import get_config as _gc
+
+    cfg = _gc(
+        "tiny-llama",
+        ["runtime.platform=cpu", "parallel.dp=4", "parallel.tp=2",
+         "data.batch_size=8", "train.grad_quant_bits=8"],
+    )
+    with pytest.raises(ValueError, match="pure DP"):
+        Trainer(cfg)
+
+
+def test_quantized_grad_reduce_rejects_loss_mask():
+    """Masked batches would need token-weighted shard reduction; the
+    quantized path must refuse rather than silently bias gradients."""
+    import jax.numpy as jnp
+
+    from orion_tpu.config import get_config as _gc
+
+    cfg = _gc(
+        "tiny-llama",
+        ["runtime.platform=cpu", "parallel.dp=8", "data.batch_size=8",
+         "train.grad_quant_bits=8", "train.log_interval=1000"],
+    )
+    t = Trainer(cfg)
+    state = t.init_state()
+    batch = dict(t.global_batch(0))
+    batch["loss_mask"] = jnp.ones_like(batch["targets"], jnp.float32)
+    with pytest.raises(ValueError, match="loss_mask"):
+        t.train_step(state, batch)
+
+
+def test_quantized_grad_reduce_with_grad_accum(single_device_baseline):
+    # accum=2 splits the global batch of 8 into [2, 4]; dp=4 divides it.
+    quant = _run(
+        "tiny-llama", 4, "parallel.dp=4", "train.grad_quant_bits=8",
+        "train.grad_accum=2",
+    )
+    for b, l in zip(single_device_baseline, quant):
+        np.testing.assert_allclose(l.loss, b.loss, rtol=2e-2, atol=2e-2)
+
+
 def test_fsdp_actually_shards_params():
     cfg = get_config(
         "tiny-llama",
