@@ -122,14 +122,17 @@ class MetricsLogger:
         if self._jsonl_file is not None:
             self._jsonl_file.write(json.dumps(m.to_dict()) + "\n")
             self._jsonl_file.flush()
-        if step % self.log_interval == 0:
-            print(
+        if step % self.log_interval == 0 or "eval_loss" in extras:
+            line = (
                 f"step {step:>6d}  loss {m.loss:8.4f}  "
                 f"gnorm {m.grad_norm:7.3f}  lr {m.learning_rate:.2e}  "
                 f"{m.step_time_s * 1e3:7.1f} ms/step  "
                 f"{m.tokens_per_sec_per_device:9.0f} tok/s/dev  "
                 f"MFU {m.mfu * 100:5.2f}%"
             )
+            if "eval_loss" in extras:
+                line += f"  eval {extras['eval_loss']:8.4f}"
+            print(line)
         return m
 
     def close(self) -> None:

@@ -2,9 +2,16 @@
 
 Reference workloads (BASELINE.json:6-12): GPT-2 125M, Llama-3 8B/70B,
 Mixtral 8x7B — all instances of ``orion_tpu.models.transformer`` selected via
-``ModelConfig`` (see the presets in orion_tpu.config).
+``ModelConfig`` (see the presets in orion_tpu.config). Weights trained in
+the reference's torch world import via ``orion_tpu.models.convert``
+(logits-parity-tested against ``transformers``).
 """
 
+from orion_tpu.models.convert import (
+    from_hf_gpt2,
+    from_hf_llama,
+    from_hf_mixtral,
+)
 from orion_tpu.models.transformer import (
     forward,
     init_params,
@@ -12,4 +19,12 @@ from orion_tpu.models.transformer import (
     param_logical_axes,
 )
 
-__all__ = ["forward", "init_params", "loss_fn", "param_logical_axes"]
+__all__ = [
+    "forward",
+    "from_hf_gpt2",
+    "from_hf_llama",
+    "from_hf_mixtral",
+    "init_params",
+    "loss_fn",
+    "param_logical_axes",
+]

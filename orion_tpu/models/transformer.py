@@ -431,7 +431,9 @@ def _hidden_states(
             num_microbatches=cfg.pp_microbatches,
         )
     elif cfg.scan_layers:
-        x, aux = jax.lax.scan(block_fn, x, params["blocks"])
+        x, aux = jax.lax.scan(
+            block_fn, x, params["blocks"], unroll=cfg.scan_unroll
+        )
         moe_aux = aux.sum()
     else:
         moe_aux = jnp.zeros((), jnp.float32)

@@ -85,7 +85,9 @@ def apply_updates(
     cfg: OptimizerConfig,
     learning_rate: jax.Array,
 ) -> tuple[Any, OptState, dict[str, jax.Array]]:
-    """One AdamW update. Returns (params, opt_state, metrics)."""
+    """One optimizer update. Returns (params, opt_state, metrics)."""
+    if cfg.name not in ("adamw", "sgd"):
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
     gnorm = global_norm(grads)
     if cfg.grad_clip_norm > 0:
         scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-9))
@@ -100,9 +102,20 @@ def apply_updates(
 
     def upd(path, p, g, mu, nu):
         g = g.astype(jnp.float32) * scale
-        mu_f = cfg.b1 * mu.astype(jnp.float32) + (1 - cfg.b1) * g
-        nu_f = cfg.b2 * nu.astype(jnp.float32) + (1 - cfg.b2) * jnp.square(g)
-        step = (mu_f / bc1) / (jnp.sqrt(nu_f / bc2) + cfg.eps)
+        if cfg.name == "sgd":
+            # Momentum SGD: mu is the velocity; nu rides along unused so
+            # the state tree (and its shardings / checkpoints) is the same
+            # shape for every optimizer family.
+            mu_f = cfg.b1 * mu.astype(jnp.float32) + g
+            step = mu_f
+            nu_f = nu.astype(jnp.float32)
+        else:
+            mu_f = cfg.b1 * mu.astype(jnp.float32) + (1 - cfg.b1) * g
+            nu_f = (
+                cfg.b2 * nu.astype(jnp.float32)
+                + (1 - cfg.b2) * jnp.square(g)
+            )
+            step = (mu_f / bc1) / (jnp.sqrt(nu_f / bc2) + cfg.eps)
         if cfg.weight_decay > 0 and _decay_mask(path):
             step = step + cfg.weight_decay * p.astype(jnp.float32)
         new_p = p.astype(jnp.float32) - learning_rate * step

@@ -330,6 +330,21 @@ class Trainer:
             self.train_step = _checked_step
         else:
             self.train_step = jax.jit(base_step, donate_argnums=(0,))
+        self.eval_loader = None
+        self._eval_batches = None
+        if cfg.train.eval_interval:
+            eval_data = _dc.replace(
+                cfg.data,
+                path=cfg.data.eval_path or cfg.data.path,
+                shuffle_seed=cfg.data.eval_seed,
+            )
+            self.eval_loader = make_loader(eval_data, cfg.model.vocab_size)
+            mcfg, mesh = self.cfg.model, self.mesh
+            self.eval_step = jax.jit(
+                lambda params, batch: loss_fn(params, batch, mcfg, mesh)[1][
+                    "ce_loss"
+                ]
+            )
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint.directory:
             self.ckpt = CheckpointManager(
@@ -389,6 +404,30 @@ class Trainer:
             host,
         )
 
+    def evaluate(self, params: Any) -> float:
+        """Mean held-out CE loss over the fixed eval batch set (the same
+        (seed, step) batches every call, so curves are comparable).
+
+        The batch set never changes, so the device arrays are built once
+        and reused across eval points (they are tiny next to model state).
+        """
+        assert self.eval_loader is not None, "set train.eval_interval"
+        if self._eval_batches is None:
+            shard = batch_sharding(self.mesh)
+            self._eval_batches = [
+                jax.tree.map(
+                    lambda v: jax.make_array_from_process_local_data(
+                        shard, v
+                    ),
+                    dict(self.eval_loader.batch_at(i)),
+                )
+                for i in range(self.cfg.train.eval_batches)
+            ]
+        total = 0.0
+        for batch in self._eval_batches:
+            total += float(jax.device_get(self.eval_step(params, batch)))
+        return total / max(len(self._eval_batches), 1)
+
     # -- loop -------------------------------------------------------------
 
     def fit(
@@ -435,6 +474,19 @@ class Trainer:
                 m = jax.device_get(m)
                 dt = watch.lap(sync_on=m["loss"])
                 watchdog.heartbeat()
+                extras = {
+                    "ce_loss": float(m["ce_loss"]),
+                    "moe_aux": float(m["moe_aux"]),
+                }
+                eval_iv = cfg.train.eval_interval
+                if eval_iv and (step + 1) % eval_iv == 0:
+                    extras["eval_loss"] = self.evaluate(state["params"])
+                    log.info(
+                        "eval at step %d: loss %.4f",
+                        step + 1,
+                        extras["eval_loss"],
+                    )
+                    watch.lap()  # keep eval time out of the next step's MFU
                 self.metrics.record(
                     step=step + 1,
                     loss=m["loss"],
@@ -442,8 +494,7 @@ class Trainer:
                     step_time_s=dt,
                     grad_norm=m["grad_norm"],
                     learning_rate=m["lr"],
-                    ce_loss=float(m["ce_loss"]),
-                    moe_aux=float(m["moe_aux"]),
+                    **extras,
                 )
                 if tracing and step + 1 >= profile[1]:
                     jax.profiler.stop_trace()

@@ -94,6 +94,17 @@ def test_scan_vs_unrolled_layers():
     np.testing.assert_allclose(np.asarray(ls), np.asarray(lu), atol=1e-5)
 
 
+def test_scan_unroll_matches_rolled():
+    """model.scan_unroll changes scheduling, not semantics."""
+    cfg = get_config("tiny-llama").model
+    cfg_u = get_config("tiny-llama", ["model.scan_unroll=2"]).model
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    l1, _ = forward(params, tokens, cfg)
+    l2, _ = forward(params, tokens, cfg_u)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
 def test_remat_matches_no_remat():
     cfg = get_config("tiny-llama").model
     cfg_r = get_config("tiny-llama", ["model.remat=full"]).model
