@@ -32,6 +32,8 @@ def main(argv: list[str] | None = None) -> int:
                         help="encode --prompt as UTF-8 bytes (vocab >= 256)")
     parser.add_argument("--max-new-tokens", type=int, default=None)
     parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--stream", action="store_true",
+                        help="print tokens incrementally as they decode")
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides"
     )
@@ -77,7 +79,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"restored checkpoint step {restored[1]}")
 
     engine = InferenceEngine(cfg, params, eos_id=args.eos_id)
-    outputs = engine.generate(prompts, args.max_new_tokens)
+    if args.stream:
+        collected: dict[int, list[int]] = {}
+        for rid, toks in engine.stream(prompts, args.max_new_tokens):
+            collected.setdefault(rid, []).extend(toks)
+            if toks:
+                print(f"request {rid} += {toks}", flush=True)
+        # Every request yields at least once (possibly []), and rids are
+        # assigned in submission order, so this realigns with prompts.
+        outputs = [collected[rid] for rid in sorted(collected)]
+    else:
+        outputs = engine.generate(prompts, args.max_new_tokens)
     for i, (prompt, out) in enumerate(zip(prompts, outputs)):
         print(f"request {i}: prompt={prompt} -> generated={out}")
         if args.byte_tokenizer:

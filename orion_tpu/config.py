@@ -217,7 +217,8 @@ class DataConfig:
     batch_size: int = 8              # global batch, in sequences
     seq_len: int = 1024
     shuffle_seed: int = 0
-    num_epochs: Optional[int] = None
+    # (No num_epochs: loaders are deterministic step-indexed streams —
+    # training length is train.num_steps; an "epoch" has no meaning here.)
     # Native (C++) loader for memmap token shards; falls back to numpy.
     use_native_loader: bool = True
     # Held-out eval stream (train.eval_interval): a separate memmap token

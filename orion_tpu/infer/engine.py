@@ -188,6 +188,42 @@ class InferenceEngine:
                 results[req.rid] = req.generated
         return [results[rid] for rid in rids]
 
+    def stream(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ):
+        """Incremental drain loop: yields ``(rid, new_tokens)`` as tokens
+        are accepted, one tuple per advanced request per engine step.
+
+        Granularity is the engine step (``inference.decode_window`` fused
+        token steps per host round-trip): lowering the window trades
+        latency-to-first-yield against throughput. Requests still waiting
+        for pool admission simply yield nothing until admitted.
+        """
+        reqs = []
+        for p in prompts:
+            self.submit(p, max_new_tokens)
+            reqs.append(self.waiting[-1])
+        emitted = [0] * len(reqs)
+        yielded = [False] * len(reqs)
+        pending = set(range(len(reqs)))
+        while pending:
+            self.step()
+            for i in sorted(pending):
+                req = reqs[i]
+                if len(req.generated) > emitted[i]:
+                    yield req.rid, req.generated[emitted[i]:]
+                    emitted[i] = len(req.generated)
+                    yielded[i] = True
+                if req.done and emitted[i] == len(req.generated):
+                    if not yielded[i]:
+                        # Zero-token completion (e.g. max_new_tokens=0
+                        # scoring): still announce the rid exactly once so
+                        # consumers see every request they submitted.
+                        yield req.rid, []
+                    pending.discard(i)
+
     # -- scheduler internals ----------------------------------------------
 
     def _bucket_len(self, n: int) -> int:

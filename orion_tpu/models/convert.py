@@ -48,6 +48,38 @@ def _cast(cfg: ModelConfig, tree: Params) -> Params:
     return jax.tree.map(lambda x: jnp.asarray(x, pdt), tree)
 
 
+def _maybe_lm_head(
+    sd: Mapping[str, np.ndarray],
+    cfg: ModelConfig,
+    params: Params,
+    embed_key: str,
+    head_key: str = "lm_head.weight",
+) -> None:
+    """Validate tie_embeddings against the checkpoint; attach lm_head.
+
+    HF state dicts from a live model include the tied head as a duplicate
+    tensor; saved checkpoints usually drop it. So presence alone is not
+    trustworthy — when cfg says tied but the dict carries a DIFFERENT head
+    than the embedding, the checkpoint is untied and silently reusing the
+    embedding would produce garbage logits.
+    """
+    if cfg.tie_embeddings:
+        if head_key in sd and not np.array_equal(
+            np.asarray(sd[head_key]), np.asarray(sd[embed_key])
+        ):
+            raise ValueError(
+                f"checkpoint has an untied {head_key} but "
+                "cfg.tie_embeddings=True; set tie_embeddings=False"
+            )
+        return
+    if head_key not in sd:
+        raise ValueError(
+            f"cfg.tie_embeddings=False but the checkpoint has no "
+            f"{head_key}; set tie_embeddings=True"
+        )
+    params["lm_head"] = np.ascontiguousarray(sd[head_key].T)
+
+
 def from_hf_llama(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     """Llama/Llama-2/Llama-3-family ``LlamaForCausalLM`` state dict."""
     L = cfg.n_layers
@@ -80,8 +112,7 @@ def from_hf_llama(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
         "final_norm": {"scale": np.asarray(sd["model.norm.weight"])},
         "blocks": _stack(cfg, blocks),
     }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = t("lm_head.weight")
+    _maybe_lm_head(sd, cfg, params, "model.embed_tokens.weight")
     return _cast(cfg, params)
 
 
@@ -132,6 +163,7 @@ def from_hf_gpt2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
         },
         "blocks": _stack(cfg, blocks),
     }
+    _maybe_lm_head(sd, cfg, params, "wte.weight")
     return _cast(cfg, params)
 
 
@@ -176,6 +208,5 @@ def from_hf_mixtral(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
         "final_norm": {"scale": np.asarray(sd["model.norm.weight"])},
         "blocks": _stack(cfg, blocks),
     }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = t("lm_head.weight")
+    _maybe_lm_head(sd, cfg, params, "model.embed_tokens.weight")
     return _cast(cfg, params)

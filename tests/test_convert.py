@@ -106,3 +106,31 @@ def test_mixtral_logits_parity():
     np.testing.assert_allclose(
         np.asarray(ours), _hf_logits(hf, TOKENS), atol=5e-4, rtol=2e-3
     )
+
+
+def test_tie_mismatch_raises():
+    """An untied checkpoint with cfg.tie_embeddings=True must refuse (the
+    silent path would reuse the embedding as the head -> garbage logits)."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    cfg_tied = ModelConfig(
+        name="t", vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=64, tie_embeddings=True,
+        dtype="float32", param_dtype="float32",
+    )
+    with pytest.raises(ValueError, match="untied"):
+        from_hf_llama(_sd(hf), cfg_tied)
+    # And the reverse: untied cfg, no head in the dict.
+    sd = {k: v for k, v in _sd(hf).items() if k != "lm_head.weight"}
+    cfg_untied = ModelConfig(
+        name="t", vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=64, tie_embeddings=False,
+        dtype="float32", param_dtype="float32",
+    )
+    with pytest.raises(ValueError, match="has no lm_head"):
+        from_hf_llama(sd, cfg_untied)

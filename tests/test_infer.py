@@ -253,3 +253,33 @@ def test_admission_burst_reserves_decode_headroom():
         f"admission burst over-committed the pool ({eng.preemptions} "
         "preemptions)"
     )
+
+
+def test_stream_matches_generate():
+    """stream() yields exactly generate()'s tokens, incrementally, in
+    window-sized chunks, ending each request exactly once."""
+    cfg, params = _setup(overrides=["inference.decode_window=2"])
+    prompts = [[5, 3, 9, 250, 17], [7, 7, 2]]
+    want = InferenceEngine(cfg, params).generate(prompts, 8)
+
+    eng = InferenceEngine(cfg, params)
+    got: dict[int, list[int]] = {}
+    chunks = 0
+    for rid, toks in eng.stream(prompts, 8):
+        assert toks, "empty yield"
+        got.setdefault(rid, []).extend(toks)
+        chunks += 1
+    rids = sorted(got)
+    assert [got[r] for r in rids] == want
+    assert chunks > len(prompts)  # incremental, not one-shot
+
+
+def test_stream_zero_token_requests_still_announced():
+    """max_new_tokens=0 (scoring) requests yield exactly one empty chunk so
+    consumers can realign outputs with prompts."""
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    events = list(eng.stream([[5, 3], [7, 1, 2]], 0))
+    assert sorted(r for r, _ in events) == sorted(set(r for r, _ in events))
+    assert len(events) == 2
+    assert all(toks == [] for _, toks in events)

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Summarize a captured jax.profiler trace into a device-time breakdown.
+
+Companion to ``train.profile_steps``: point it at the profile directory and
+it prints the leaf TPU-op groups by share of device time — the same
+analysis behind PERF.md's table. No TPU needed; parses the trace offline.
+
+Usage:
+    python train.py --preset llama-1b-bench 'train.profile_steps=(5,7)' \
+        train.profile_dir=/tmp/prof
+    python tools/profile_report.py /tmp/prof
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import re
+import sys
+
+
+def find_trace(root: str) -> str:
+    traces = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
+    if not traces:
+        raise SystemExit(f"no *.trace.json.gz under {root}")
+    return traces[-1]  # newest capture
+
+
+# Container events (enclose leaf ops; counting them double-counts time).
+_SKIP = re.compile(r"^(jit_|while|\d+$|body|condition|region|cond)")
+
+
+def leaf_groups(trace_path: str) -> tuple[dict[str, float], float]:
+    with gzip.open(trace_path) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    pids = {
+        p["pid"]: p.get("args", {}).get("name", "")
+        for p in events
+        if p.get("ph") == "M" and p.get("name") == "process_name"
+    }
+    dur: collections.Counter = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if "TPU" not in pids.get(e.get("pid"), ""):
+            continue
+        name = e.get("name", "?")
+        if _SKIP.match(name):
+            continue
+        group = re.sub(r"\.\d+(\.remat\d*)?(\.clone)?$", "", name)
+        dur[group] += e["dur"]
+    return dict(dur), sum(dur.values())
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__)
+        return 2
+    trace = find_trace(argv[1])
+    groups, total = leaf_groups(trace)
+    print(f"trace: {trace}")
+    print(f"leaf device time: {total / 1e3:.1f} ms\n")
+    print(f"{'ms':>10}  {'share':>6}  group")
+    for name, d in sorted(groups.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"{d / 1e3:10.2f}  {100 * d / total:5.1f}%  {name[:70]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
