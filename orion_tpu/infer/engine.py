@@ -35,6 +35,10 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     generated: list[int] = field(default_factory=list)
+    # Per-request sampling overrides; None = inference.* config defaults.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
     # scheduler state
     slot: Optional[int] = None
     pages: list[int] = field(default_factory=list)
@@ -97,7 +101,26 @@ class InferenceEngine:
         self._key = jax.random.key(seed)
         self.preemptions = 0
 
+        # Per-slot sampling params (inference.* defaults; submit() can
+        # override per request, vLLM-style).
+        self.slot_temp = np.full(self.max_batch, self.icfg.temperature,
+                                 np.float32)
+        self.slot_top_k = np.full(self.max_batch, self.icfg.top_k, np.int32)
+        self.slot_top_p = np.full(self.max_batch, self.icfg.top_p,
+                                  np.float32)
         self._decode = jax.jit(
+            partial(
+                decode_window,
+                cfg=self.mcfg,
+                max_seq_len=self.icfg.max_seq_len,
+            ),
+            donate_argnums=(1,),
+        )
+        # Static specialization for the common all-defaults case: binding
+        # python scalars via partial keeps them trace-time constants, so
+        # sample()'s greedy short-circuit applies and the decode program
+        # compiles no sampling machinery (no [B, V] sort per token).
+        self._decode_defaults = jax.jit(
             partial(
                 decode_window,
                 cfg=self.mcfg,
@@ -120,7 +143,13 @@ class InferenceEngine:
     # -- public API --------------------------------------------------------
 
     def submit(
-        self, prompt: Sequence[int], max_new_tokens: Optional[int] = None
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        *,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
     ) -> int:
         if not len(prompt):
             raise ValueError("empty prompt")
@@ -156,6 +185,9 @@ class InferenceEngine:
             rid=next(self._rid),
             prompt=list(map(int, prompt)),
             max_new_tokens=max_new,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
         )
         self.waiting.append(req)
         return req.rid
@@ -206,7 +238,6 @@ class InferenceEngine:
             self.submit(p, max_new_tokens)
             reqs.append(self.waiting[-1])
         emitted = [0] * len(reqs)
-        yielded = [False] * len(reqs)
         pending = set(range(len(reqs)))
         while pending:
             self.step()
@@ -215,9 +246,8 @@ class InferenceEngine:
                 if len(req.generated) > emitted[i]:
                     yield req.rid, req.generated[emitted[i]:]
                     emitted[i] = len(req.generated)
-                    yielded[i] = True
                 if req.done and emitted[i] == len(req.generated):
-                    if not yielded[i]:
+                    if emitted[i] == 0:
                         # Zero-token completion (e.g. max_new_tokens=0
                         # scoring): still announce the rid exactly once so
                         # consumers see every request they submitted.
@@ -269,6 +299,17 @@ class InferenceEngine:
             req.admit_seq = next(self._admit_seq)
             req.pages = self.alloc.alloc(n_pages)
             self.slots[slot] = req
+            icfg = self.icfg
+            self.slot_temp[slot] = (
+                icfg.temperature if req.temperature is None
+                else req.temperature
+            )
+            self.slot_top_k[slot] = (
+                icfg.top_k if req.top_k is None else req.top_k
+            )
+            self.slot_top_p[slot] = (
+                icfg.top_p if req.top_p is None else req.top_p
+            )
             self.page_table[slot, :n_pages] = req.pages
             self.seq_lens[slot] = len(context)
             admitted.append((req, s_pad))
@@ -301,7 +342,7 @@ class InferenceEngine:
             jnp.asarray(lengths),
             jnp.asarray(pages),
         )
-        firsts = self._sample(logits)
+        firsts = self._sample(logits, reqs)
         for i, req in enumerate(reqs):
             if req.max_new_tokens <= 0:
                 req.done = True   # prefill-only (scoring) request
@@ -370,7 +411,7 @@ class InferenceEngine:
             [r is not None and not r.done for r in self.slots], bool
         )
         self._key, sub = jax.random.split(self._key)
-        toks, self.cache = self._decode(
+        common = (
             self.params,
             self.cache,
             jnp.asarray(self.last_token),
@@ -379,6 +420,18 @@ class InferenceEngine:
             jnp.asarray(mask),
             jax.random.split(sub, W),
         )
+        if all(
+            r.temperature is None and r.top_k is None and r.top_p is None
+            for r in active
+        ):
+            toks, self.cache = self._decode_defaults(*common)
+        else:
+            toks, self.cache = self._decode(
+                *common,
+                jnp.asarray(self.slot_temp),
+                jnp.asarray(self.slot_top_k),
+                jnp.asarray(self.slot_top_p),
+            )
         tokens = np.asarray(jax.device_get(toks))   # [W, B], ONE fetch
         for j in range(W):
             for req in active:
@@ -391,14 +444,39 @@ class InferenceEngine:
                 self._maybe_finish(req, tok)
         self._reap()
 
-    def _sample(self, logits: jax.Array) -> np.ndarray:
+    def _sample(
+        self, logits: jax.Array, reqs: Optional[list[Request]] = None
+    ) -> np.ndarray:
+        icfg = self.icfg
         self._key, sub = jax.random.split(self._key)
+        if not any(
+            r.temperature is not None or r.top_k is not None
+            or r.top_p is not None
+            for r in (reqs or [])
+        ):
+            # All-defaults: python scalars keep the greedy short-circuit.
+            toks = sample(
+                logits, sub, temperature=icfg.temperature,
+                top_k=icfg.top_k, top_p=icfg.top_p,
+            )
+            return np.asarray(jax.device_get(toks))
+        # Requests here are admitted (slots assigned), and _admit already
+        # resolved the None-means-default rule into the slot arrays — gather
+        # from there so the resolution lives in exactly one place.
+        nb = logits.shape[0]
+        temp = np.full(nb, icfg.temperature, np.float32)
+        top_k = np.full(nb, icfg.top_k, np.int32)
+        top_p = np.full(nb, icfg.top_p, np.float32)
+        for i, req in enumerate(reqs or []):
+            temp[i] = self.slot_temp[req.slot]
+            top_k[i] = self.slot_top_k[req.slot]
+            top_p[i] = self.slot_top_p[req.slot]
         toks = sample(
             logits,
             sub,
-            temperature=self.icfg.temperature,
-            top_k=self.icfg.top_k,
-            top_p=self.icfg.top_p,
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
         )
         return np.asarray(jax.device_get(toks))
 

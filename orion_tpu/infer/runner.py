@@ -217,11 +217,11 @@ def decode_window(
     page_table: jax.Array,    # [B, pages_per_seq] int32
     active: jax.Array,        # [B] bool: slot holds a live request
     keys: jax.Array,          # [W] PRNG keys, one per inner step
+    temperature: jax.Array,   # [B] f32 per-request (vLLM-style params)
+    top_k: jax.Array,         # [B] i32
+    top_p: jax.Array,         # [B] f32
     cfg: ModelConfig,
     max_seq_len: int,
-    temperature: float,
-    top_k: int,
-    top_p: float,
 ) -> tuple[jax.Array, Cache]:
     """W fused decode+sample steps; returns (tokens [W, B] int32, cache).
 

@@ -16,39 +16,79 @@ def sample(
     logits: jax.Array,
     key: jax.Array,
     *,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
 ) -> jax.Array:
     """logits: [B, V] -> sampled token ids [B] int32.
 
-    temperature <= 0 means greedy argmax (the deterministic mode the
+    Each parameter is a python scalar (whole batch) or a [B] array
+    (per-request sampling params, vLLM-style). temperature <= 0 means
+    greedy argmax for that row (the deterministic mode the
     batching-equivalence tests rely on). top_k=0 / top_p=1.0 disable the
     respective filters.
+
+    The all-scalar greedy case short-circuits to a bare argmax — the bench
+    path compiles no sampling machinery.
     """
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Trace-time constants (python scalars, e.g. bound via functools.partial
+    # before jit) let disabled filters compile to nothing: the greedy bench
+    # decode is a bare argmax, plain-temperature sampling skips the [B, V]
+    # sort/softmax/cumsum entirely.
+    no_topk = isinstance(top_k, int) and top_k == 0
+    no_topp = isinstance(top_p, (int, float)) and top_p >= 1.0
+    if isinstance(temperature, (int, float)):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if no_topk and no_topp:
+            scaled = logits.astype(jnp.float32) / temperature
+            return jax.random.categorical(key, scaled, axis=-1).astype(
+                jnp.int32
+            )
 
-    logits = logits.astype(jnp.float32) / temperature
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
 
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, NEG_INF, logits)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
 
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # Keep the smallest prefix with cumulative mass >= top_p (always
-        # keep the argmax itself).
-        keep_sorted = jnp.concatenate(
-            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1
+    if not (no_topk and no_topp):
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+
+    if not no_topk:
+        # top-k: threshold at the k-th largest logit per row (0 disables).
+        kth_idx = jnp.clip(top_k - 1, 0, V - 1)[:, None]
+        kth = jnp.take_along_axis(sorted_desc, kth_idx, axis=-1)
+        scaled = jnp.where(
+            (top_k[:, None] > 0) & (scaled < kth), NEG_INF, scaled
         )
-        # Threshold = smallest kept logit per row.
+
+    if not no_topp:
+        # top-p: keep the smallest prefix with cumulative mass >= top_p
+        # (always keep the row argmax). 1.0 disables. Mass is measured on
+        # the top-k-filtered distribution (descending positions >= k are
+        # the filtered-out tail), matching filters applied in sequence.
+        idx = jnp.arange(V)[None, :]
+        sorted_masked = jnp.where(
+            (top_k[:, None] > 0) & (idx >= top_k[:, None]),
+            NEG_INF,
+            sorted_desc,
+        )
+        probs = jax.nn.softmax(sorted_masked, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones((B, 1), bool), cum[:, :-1] < top_p[:, None]], axis=-1
+        )
         thresh = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            jnp.where(keep_sorted, sorted_masked, jnp.inf), axis=-1,
             keepdims=True,
         )
-        logits = jnp.where(logits < thresh, NEG_INF, logits)
+        scaled = jnp.where(
+            (top_p[:, None] < 1.0) & (scaled < thresh), NEG_INF, scaled
+        )
 
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
