@@ -221,6 +221,14 @@ class DataConfig:
     # training length is train.num_steps; an "epoch" has no meaning here.)
     # Native (C++) loader for memmap token shards; falls back to numpy.
     use_native_loader: bool = True
+    # Sequence packing: batches carry multiple documents per row with
+    # segment_ids / per-segment positions / a loss_mask over padding, and
+    # attention is masked at document boundaries (the flash kernel's
+    # segment path). Synthetic: variable-length documents; memmap: windows
+    # split at eos_token_id occurrences. Incompatible with parallel.pp
+    # (pipeline microbatching cannot carry per-row segment state).
+    packed: bool = False
+    eos_token_id: int = 0            # document separator for packed memmap
     # Held-out eval stream (train.eval_interval): a separate memmap token
     # file, or — for synthetic/same-file setups — the train source under a
     # different shuffle seed (disjoint windows with high probability).

@@ -42,6 +42,52 @@ class Loader(abc.ABC):
         """Host-local shard: inputs/targets [host_batch, seq_len] int32."""
 
 
+def pack_rows(
+    docs_per_row: list[list[np.ndarray]], seq_len: int
+) -> Batch:
+    """Pack variable-length documents into fixed [B, S] packed batches.
+
+    Each document contributes its (input, target) pairs independently —
+    targets never cross a document boundary, attention is confined to the
+    document via ``segment_ids``, RoPE restarts via ``positions``, and the
+    padding tail is excluded via ``loss_mask``. This is the host-side half
+    of the packed path; the device half is the flash kernel's segment
+    masking (ops/pallas/flash_attention.py) + position-aware RoPE.
+
+    Segment id 0 is reserved for padding (matches the kernel's convention
+    that distinct ids never attend to each other; padding rows also carry
+    loss_mask 0 so their nll is dropped).
+    """
+    B = len(docs_per_row)
+    inputs = np.zeros((B, seq_len), np.int32)
+    targets = np.zeros((B, seq_len), np.int32)
+    segments = np.zeros((B, seq_len), np.int32)
+    positions = np.zeros((B, seq_len), np.int32)
+    mask = np.zeros((B, seq_len), np.float32)
+    for b, docs in enumerate(docs_per_row):
+        at = 0
+        for s, doc in enumerate(docs):
+            doc = np.asarray(doc)
+            if at >= seq_len:
+                break          # row is full
+            if len(doc) < 2:
+                continue       # degenerate doc: skip, keep packing the rest
+            n = min(len(doc) - 1, seq_len - at)  # pairs, not tokens
+            inputs[b, at : at + n] = doc[:n]
+            targets[b, at : at + n] = doc[1 : n + 1]
+            segments[b, at : at + n] = s + 1
+            positions[b, at : at + n] = np.arange(n)
+            mask[b, at : at + n] = 1.0
+            at += n
+    return {
+        "inputs": inputs,
+        "targets": targets,
+        "segment_ids": segments,
+        "positions": positions,
+        "loss_mask": mask,
+    }
+
+
 class SyntheticLoader(Loader):
     """Deterministic pseudo-random tokens with a learnable structure.
 
@@ -55,11 +101,27 @@ class SyntheticLoader(Loader):
         super().__init__(cfg, process_index, process_count)
         self.vocab_size = vocab_size
 
+    def _doc(self, rng, length: int) -> np.ndarray:
+        start = rng.integers(0, self.vocab_size)
+        ramp = np.arange(length, dtype=np.int64)
+        noise = rng.integers(0, 2, size=length)
+        return ((start + 3 * ramp + noise) % self.vocab_size).astype(np.int32)
+
     def batch_at(self, step: int) -> Batch:
         b, s = self.host_batch, self.cfg.seq_len
         rng = np.random.default_rng(
             (self.cfg.shuffle_seed, step, self.process_index)
         )
+        if self.cfg.packed:
+            rows = []
+            for _ in range(b):
+                docs, filled = [], 0
+                while filled < s:
+                    length = int(rng.integers(8, max(9, s // 2)))
+                    docs.append(self._doc(rng, length + 1))
+                    filled += length
+                rows.append(docs)
+            return pack_rows(rows, s)
         start = rng.integers(0, self.vocab_size, size=(b, 1))
         ramp = np.arange(s + 1, dtype=np.int64)[None, :]
         noise = rng.integers(0, 2, size=(b, s + 1))
@@ -104,6 +166,19 @@ class MemmapLoader(Loader):
             # this step trains (native reader issues MADV_WILLNEED).
             self.reader.prefetch(self._offsets_at(step + 1), s + 1)
         rows = rows.astype(np.int32)
+        if self.cfg.packed:
+            eos = self.cfg.eos_token_id
+            docs_per_row = []
+            for row in rows:
+                cuts = np.flatnonzero(row == eos)
+                bounds = [0, *(int(c) + 1 for c in cuts), len(row)]
+                docs = [
+                    row[a:b]
+                    for a, b in zip(bounds[:-1], bounds[1:])
+                    if b - a >= 2
+                ]
+                docs_per_row.append(docs or [row])
+            return pack_rows(docs_per_row, s)
         return {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
 
 

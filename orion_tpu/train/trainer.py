@@ -238,6 +238,11 @@ class Trainer:
     def __init__(self, cfg: Config):
         import dataclasses as _dc
 
+        if cfg.data.packed and cfg.parallel.pp > 1:
+            raise ValueError(
+                "data.packed is incompatible with parallel.pp: pipeline "
+                "microbatching cannot carry per-row segment state"
+            )
         if cfg.parallel.pp > 1:
             # Route the layer stack through the GPipe pipeline over pp
             # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
