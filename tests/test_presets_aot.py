@@ -40,3 +40,50 @@ def test_flagship_preset_train_step_lowers(cpu_devices, preset, axes):
     lowered = t.train_step.lower(state, batch_shapes)
     hlo = lowered.as_text()
     assert "ENTRY" in hlo or "func.func" in hlo  # non-empty lowered module
+
+
+def test_serving_preset_decode_program_lowers(cpu_devices):
+    """BASELINE config 5 (llama3-8b-infer): the fused decode-window program
+    lowers at full model size with abstract params/cache — the serving path
+    is a demonstrably compilable program, not just a declared preset."""
+    from functools import partial
+
+    from orion_tpu.infer.kv_cache import init_cache, pages_per_seq
+    from orion_tpu.infer.runner import decode_window
+    from orion_tpu.models import init_params
+
+    cfg = get_config("llama3-8b-infer", ["runtime.platform=cpu"])
+    mcfg, icfg = cfg.model, cfg.inference
+    B, W = icfg.max_batch_size, icfg.decode_window
+    pps = pages_per_seq(icfg)
+
+    params = jax.eval_shape(lambda: init_params(mcfg, jax.random.key(0)))
+    cache = jax.eval_shape(lambda: init_cache(mcfg, icfg))
+    keys = jax.eval_shape(
+        lambda: jax.random.split(jax.random.key(0), W)
+    )
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, "int32")
+    common = (
+        params, cache, i32(B), i32(B), i32(B, pps),
+        jax.ShapeDtypeStruct((B,), "bool"), keys,
+    )
+    # Greedy all-defaults specialization (what the bench decode compiles).
+    lowered = jax.jit(
+        partial(
+            decode_window, cfg=mcfg, max_seq_len=icfg.max_seq_len,
+            temperature=icfg.temperature, top_k=icfg.top_k,
+            top_p=icfg.top_p,
+        ),
+        donate_argnums=(1,),
+    ).lower(*common)
+    hlo = lowered.as_text()
+    assert "ENTRY" in hlo or "func.func" in hlo
+    # The general per-request sampling program (traced [B] params, full
+    # top-k/top-p machinery at V=128256) — greedy is a subgraph of this.
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, "float32")
+    lowered = jax.jit(
+        partial(decode_window, cfg=mcfg, max_seq_len=icfg.max_seq_len),
+        donate_argnums=(1,),
+    ).lower(*common, f32(B), i32(B), f32(B))
+    hlo = lowered.as_text()
+    assert "ENTRY" in hlo or "func.func" in hlo
