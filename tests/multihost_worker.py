@@ -1,6 +1,7 @@
 """Worker entry for the multi-host distributed test (run as a subprocess).
 
-Usage: python tests/multihost_worker.py <process_id> <num_processes> <port>
+Usage: python tests/multihost_worker.py <process_id> <num_processes> <port> \
+           [extra.override=value ...]
 
 Runs a short data-parallel training through the REAL runtime bring-up path
 (SURVEY.md §4 stack C): runtime.initialize -> jax.distributed rendezvous ->
@@ -28,7 +29,7 @@ def main() -> int:
         "train.num_steps=20",
         "train.log_interval=1000",
         "optimizer.warmup_steps=2",
-    ])
+    ] + sys.argv[4:])
     hist = Trainer(cfg).fit()
     print("RESULT " + json.dumps([float(h.loss) for h in hist]), flush=True)
     return 0

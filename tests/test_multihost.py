@@ -22,7 +22,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_data_parallel_training():
+def _run_two_process(extra=()):
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -31,16 +31,23 @@ def test_two_process_data_parallel_training():
 
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2", str(port)],
+            [sys.executable, WORKER, str(pid), "2", str(port), *extra],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env,
         )
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=280)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+    finally:
+        # A hung rendezvous (peer died at startup) must not leak workers
+        # spinning for the rest of the pytest session.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
 
@@ -49,8 +56,29 @@ def test_two_process_data_parallel_training():
         line = [l for l in out.splitlines() if l.startswith("RESULT ")]
         assert line, f"no RESULT line in:\n{out}"
         losses.append(json.loads(line[0][len("RESULT "):]))
+    return losses
 
+
+@pytest.fixture(scope="module")
+def exact_two_process_losses():
+    """One exact-reduction run shared by both tests (each run spawns two
+    full jax.distributed bring-ups; no need to pay for it twice)."""
+    return _run_two_process()
+
+
+def test_two_process_data_parallel_training(exact_two_process_losses):
+    losses = exact_two_process_losses
     # SPMD: both processes observe the identical global loss trajectory.
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
     # And training makes progress on the shared global batch.
     assert losses[0][-1] < losses[0][0] - 0.2, losses[0]
+
+
+def test_two_process_int8_grad_reduce(exact_two_process_losses):
+    """The quantized DP gradient all-reduce (train.grad_quant_bits=8) over
+    a REAL cross-process collective backend — the wire path it exists for
+    (the dp axis spanning hosts) — tracks the exact-reduction trajectory."""
+    quant = _run_two_process(["train.grad_quant_bits=8"])
+    np.testing.assert_allclose(quant[0], quant[1], rtol=1e-6)
+    for a, b in zip(exact_two_process_losses[0], quant[0]):
+        np.testing.assert_allclose(b, a, rtol=3e-2, atol=3e-2)
