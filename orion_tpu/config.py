@@ -50,6 +50,11 @@ class ModelConfig:
     attn_bias: bool = False
     mlp_bias: bool = False
     attn_logit_softcap: Optional[float] = None
+    # Sliding-window attention (Mistral-family): attend only to the last N
+    # positions. Training-path feature (xla + flash kernel, with block
+    # skipping); unsupported under sequence parallelism and in the serving
+    # engine (both attend full context and raise if set).
+    sliding_window: Optional[int] = None
 
     # Mixture-of-experts (0 experts => dense MLP).
     n_experts: int = 0
@@ -497,6 +502,27 @@ def _p_llama8b_dp() -> Config:
         model=_llama3_8b_model(),
         parallel=ParallelConfig(dp=64),
         data=DataConfig(batch_size=64, seq_len=8192),
+        optimizer=OptimizerConfig(learning_rate=3e-4),
+    )
+
+
+@register_preset("mistral-7b-fsdp")
+def _p_mistral7b() -> Config:
+    """Mistral-7B: Llama-family architecture + sliding-window attention
+    (model.sliding_window; the flash kernel skips blocks behind the
+    window). Weights import via models.convert.from_hf_llama (same state-
+    dict schema)."""
+    return Config(
+        model=ModelConfig(
+            name="mistral-7b", vocab_size=32000, max_seq_len=8192,
+            d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            d_ff=14336, pos_embedding="rope", rope_theta=10_000.0,
+            norm="rmsnorm", norm_eps=1e-5, activation="swiglu",
+            tie_embeddings=False, sliding_window=4096,
+            dtype="bfloat16", kernels="pallas", remat="full",
+        ),
+        parallel=ParallelConfig(fsdp=8),
+        data=DataConfig(batch_size=32, seq_len=8192),
         optimizer=OptimizerConfig(learning_rate=3e-4),
     )
 

@@ -273,6 +273,11 @@ def _attn_block(
         and mesh.shape.get(cfg.sequence_axis, 1) > 1
     )
     if sp_active:
+        if cfg.sliding_window is not None:
+            raise ValueError(
+                "model.sliding_window is not supported with sequence "
+                "parallelism (the ring/Ulysses paths attend full context)"
+            )
         from orion_tpu.parallel.sequence import sequence_attention
 
         out = sequence_attention(
@@ -291,6 +296,10 @@ def _attn_block(
             impl=cfg.kernels,
         )
     else:
+        # Window distance is measured on token INDEX, which equals position
+        # distance within a document for contiguous packed rows (positions
+        # restart per doc but stay contiguous); cross-document pairs are
+        # segment-masked regardless.
         out = ops.attention(
             q,
             k,
@@ -299,6 +308,7 @@ def _attn_block(
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
             logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.sliding_window,
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,

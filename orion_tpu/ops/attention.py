@@ -34,13 +34,35 @@ def attention_mask(
     q_offset: int = 0,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> Optional[jax.Array]:
-    """Boolean [.., q_len, kv_len] mask; True = attend."""
+    """Boolean [.., q_len, kv_len] mask; True = attend.
+
+    ``window`` (sliding-window / Mistral-family) keeps only the last
+    ``window`` positions: 0 <= q_pos - kv_pos < window. Positions default
+    to token index (+ q_offset for q); explicit per-token positions
+    ([.., q_len] / [.., kv_len]) serve packed/permuted layouts.
+    """
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal attention and window >= 1"
+        )
     mask = None
     if causal:
-        q_pos = jnp.arange(q_len) + q_offset
-        kv_pos = jnp.arange(kv_len)
-        mask = q_pos[:, None] >= kv_pos[None, :]
+        q_pos = (
+            q_positions
+            if q_positions is not None
+            else jnp.arange(q_len) + q_offset
+        )
+        kv_pos = (
+            kv_positions if kv_positions is not None else jnp.arange(kv_len)
+        )
+        dist = q_pos[..., :, None] - kv_pos[..., None, :]
+        mask = dist >= 0
+        if window is not None:
+            mask &= dist < window
     if q_segment_ids is not None:
         seg = q_segment_ids[..., :, None] == kv_segment_ids[..., None, :]
         mask = seg if mask is None else (mask & seg)
@@ -58,6 +80,9 @@ def attention_xla(
     kv_segment_ids: Optional[jax.Array] = None,
     logit_softcap: Optional[float] = None,
     q_offset: int = 0,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """q: [B, Sq, N, H]; k, v: [B, Skv, K, H] with N % K == 0 -> [B, Sq, N, H]."""
     dtype = q.dtype
@@ -65,6 +90,11 @@ def attention_xla(
     k = _gqa_expand(k, n_heads)
     v = _gqa_expand(v, n_heads)
 
+    if mask is not None and window is not None:
+        raise ValueError(
+            "window cannot combine with an explicit mask (it would be "
+            "silently ignored); fold the window into the mask or drop it"
+        )
     scale = head_dim ** -0.5
     logits = jnp.einsum(
         "bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32
@@ -80,6 +110,9 @@ def attention_xla(
             q_offset=q_offset,
             q_segment_ids=q_segment_ids,
             kv_segment_ids=kv_segment_ids,
+            q_positions=q_positions,
+            kv_positions=kv_positions,
+            window=window,
         )
     if mask is not None:
         if mask.ndim == 2:
@@ -103,6 +136,9 @@ def attention(
     kv_segment_ids: Optional[jax.Array] = None,
     logit_softcap: Optional[float] = None,
     q_offset: int = 0,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     impl: str = "xla",
@@ -128,6 +164,9 @@ def attention(
             kv_segment_ids=kv_segment_ids,
             logit_softcap=logit_softcap,
             q_offset=q_offset,
+            q_positions=q_positions,
+            kv_positions=kv_positions,
+            window=window,
             block_q=block_q,
             block_kv=block_kv,
             interpret=interpret,
@@ -142,4 +181,7 @@ def attention(
         kv_segment_ids=kv_segment_ids,
         logit_softcap=logit_softcap,
         q_offset=q_offset,
+        q_positions=q_positions,
+        kv_positions=kv_positions,
+        window=window,
     )

@@ -55,6 +55,11 @@ class _Statics:
     # causal masking compares position ARRAYS instead of index iotas, and
     # the causal block-skip becomes a dynamic min/max test on them.
     has_pos: bool = False
+    # Sliding-window attention (Mistral-family): attend only to the last
+    # `window` positions, i.e. 0 <= q_pos - kv_pos < window (requires
+    # causal). Blocks entirely behind the window skip like causal blocks
+    # entirely ahead of the diagonal.
+    window: Optional[int] = None
 
 
 def _unpack_refs(has_seg: bool, has_pos: bool, refs):
@@ -88,12 +93,15 @@ def _block_mask(st: _Statics, iq, ik, qseg_ref, kseg_ref, qpos_ref, kpos_ref):
         if st.has_pos:
             q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
             kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
-            mask &= q_ids[:, None] >= kv_ids[None, :]
+            dist = q_ids[:, None] - kv_ids[None, :]
         else:
             q_pos = iq * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0
             )
-            mask &= (q_pos + st.q_offset) >= kv_idx
+            dist = (q_pos + st.q_offset) - kv_idx
+        mask &= dist >= 0
+        if st.window is not None:
+            mask &= dist < st.window
     if qseg_ref is not None:
         q_ids = qseg_ref[0, 0, pl.ds(iq * bq, bq)]
         kv_ids = kseg_ref[0, 0, pl.ds(ik * bk, bk)]
@@ -114,9 +122,19 @@ def _block_run(st: _Statics, iq, ik, qpos_ref, kpos_ref):
     if st.has_pos:
         q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
         kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
-        return jnp.max(q_ids) >= jnp.min(kv_ids)
+        run = jnp.max(q_ids) >= jnp.min(kv_ids)
+        if st.window is not None:
+            # Skip blocks entirely behind the window: largest kv position
+            # within reach of the smallest q position. (kv padding is
+            # PAD_POS_KV, so padded blocks stay runnable-but-masked.)
+            run &= jnp.max(kv_ids) > jnp.min(q_ids) - st.window
+        return run
     q_max = iq * bq + bq - 1 + st.q_offset
-    return ik * bk <= q_max
+    run = ik * bk <= q_max
+    if st.window is not None:
+        q_min = iq * bq + st.q_offset
+        run = run & (ik * bk + bk - 1 > q_min - st.window)
+    return run
 
 
 def _scaled_logits(st: _Statics, q, k, scale):
@@ -511,7 +529,7 @@ PAD_POS_KV = 2 ** 30  # kv-position pad: larger than any real position, so
 def _prep(
     q, k, v, q_segment_ids, kv_segment_ids,
     causal, logit_softcap, q_offset, block_q, block_kv, interpret,
-    q_positions=None, kv_positions=None,
+    q_positions=None, kv_positions=None, window=None,
 ):
     """Shared wrapper prep: statics + [B,N,S,H] transpose + block padding.
 
@@ -522,6 +540,10 @@ def _prep(
     """
     assert (q_segment_ids is None) == (kv_segment_ids is None)
     assert (q_positions is None) == (kv_positions is None)
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal attention and window >= 1"
+        )
     B, Sq, N, H = q.shape
     Skv, K = k.shape[1], k.shape[2]
     assert N % K == 0, (N, K)
@@ -539,6 +561,7 @@ def _prep(
         block_kv=bk,
         interpret=resolve_interpret(interpret),
         has_pos=q_positions is not None,
+        window=window,
     )
 
     qt = pad_axis(q.transpose(0, 2, 1, 3), 2, Sq_p)
@@ -582,19 +605,22 @@ def flash_attention(
     interpret: Optional[bool] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention; shapes/semantics match ``attention_xla``.
 
     q: [B, Sq, N, H]; k, v: [B, Skv, K, H] with N % K == 0 -> [B, Sq, N, H].
     With ``q_positions``/``kv_positions`` ([B, S] or [S] int32), causal
     masking compares those explicit positions (permuted/striped sequence
-    layouts); otherwise token index + ``q_offset``.
+    layouts); otherwise token index + ``q_offset``. ``window`` restricts
+    attention to the last ``window`` positions (sliding-window / Mistral;
+    blocks fully behind the window skip their compute).
     See ``_prep`` for the tile-size default rationale.
     """
     st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq = _prep(
         q, k, v, q_segment_ids, kv_segment_ids,
         causal, logit_softcap, q_offset, block_q, block_kv, interpret,
-        q_positions, kv_positions,
+        q_positions, kv_positions, window,
     )
     o = _flash(st, qt, kt, vt, qseg, kseg, qpos, kpos)
     return o[:, :, :Sq, :].transpose(0, 2, 1, 3)

@@ -44,10 +44,13 @@ def _run_two_process(extra=()):
             outs.append(out)
     finally:
         # A hung rendezvous (peer died at startup) must not leak workers
-        # spinning for the rest of the pytest session.
+        # spinning for the rest of the pytest session; reap them and
+        # surface whatever they printed before dying.
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                out, _ = p.communicate()
+                print(f"killed hung worker output:\n{out}")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
 

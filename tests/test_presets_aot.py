@@ -22,6 +22,7 @@ from orion_tpu.train import Trainer
         ("llama3-8b-dp", {"dp": 8}),
         ("llama3-70b-fsdp", {"fsdp": 8}),
         ("mixtral-8x7b-ep", {"fsdp": 2, "ep": 4}),
+        ("mistral-7b-fsdp", {"fsdp": 8}),
     ],
 )
 def test_flagship_preset_train_step_lowers(cpu_devices, preset, axes):

@@ -65,6 +65,33 @@ def main() -> int:
     for name, gp, gx in zip("qkv", g_p, g_x):
         ok &= check(f"flash d{name}", gp, gx, 4e-2)
 
+    # Sliding-window flash (Mistral-family): fwd + dq on chip.
+    def loss_pw(q, k, v):
+        o = flash_attention(q, k, v, window=128, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_xw(q, k, v):
+        o = attention_xla(q, k, v, causal=True, window=128)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    ok &= check(
+        "flash window fwd",
+        jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, window=128,
+                                            interpret=False)
+        )(q, k, v),
+        jax.jit(
+            lambda q, k, v: attention_xla(q, k, v, causal=True, window=128)
+        )(q, k, v),
+        2e-2,
+    )
+    ok &= check(
+        "flash window dq",
+        jax.jit(jax.grad(loss_pw))(q, k, v),
+        jax.jit(jax.grad(loss_xw))(q, k, v),
+        4e-2,
+    )
+
     # RMSNorm.
     x = jax.random.normal(jax.random.key(0), (2, 512, 2048), jnp.bfloat16)
     w = jax.random.normal(jax.random.key(3), (2048,), jnp.float32) * 0.1 + 1.0
