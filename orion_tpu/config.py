@@ -51,9 +51,10 @@ class ModelConfig:
     mlp_bias: bool = False
     attn_logit_softcap: Optional[float] = None
     # Sliding-window attention (Mistral-family): attend only to the last N
-    # positions. Training-path feature (xla + flash kernel, with block
-    # skipping); unsupported under sequence parallelism and in the serving
-    # engine (both attend full context and raise if set).
+    # positions. Supported in training (xla + flash kernel, with block
+    # skipping) and serving (prefill + both decode paths; the paged kernel
+    # skips pages behind the window, making decode O(window)). Unsupported
+    # under sequence parallelism (the ring/Ulysses paths raise).
     sliding_window: Optional[int] = None
 
     # Mixture-of-experts (0 experts => dense MLP).

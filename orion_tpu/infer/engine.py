@@ -86,12 +86,6 @@ class InferenceEngine:
                 f"multiple of page_size={self.psz}"
             )
 
-        if self.mcfg.sliding_window is not None:
-            raise NotImplementedError(
-                "model.sliding_window is a training-path feature; the "
-                "serving engine attends the full paged context and would "
-                "silently diverge from training semantics"
-            )
         self.cache = init_cache(self.mcfg, self.icfg)
         self.alloc = PageAllocator(self.icfg.num_pages)
         self.page_table = np.zeros(

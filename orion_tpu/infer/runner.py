@@ -104,6 +104,7 @@ def prefill_step(
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         out = attention(
             q, k, v, causal=True,
+            window=cfg.sliding_window,
             block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
         )
@@ -157,11 +158,14 @@ def _decode_core(
 
     page_idx = page_table[batch_idx, write_pos // psz]   # [B]
     offset = write_pos % psz                             # [B]
-    # KV positions valid after the write: arange <= write_pos.
-    kv_mask = (
-        jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
-        <= write_pos[:, None, None]
-    )                                                    # [B, 1, P*psz]
+    # KV positions valid after the write: arange <= write_pos (and within
+    # the sliding window when configured: write_pos - kv_pos < window).
+    kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    kv_mask = kv_arange <= write_pos[:, None, None]      # [B, 1, P*psz]
+    if cfg.sliding_window is not None:
+        kv_mask &= (
+            kv_arange >= (write_pos - cfg.sliding_window + 1)[:, None, None]
+        )
 
     from orion_tpu.ops._dispatch import resolve_impl
 
@@ -185,6 +189,7 @@ def _decode_core(
                 layer_base=l * NP,
                 k_new=k[:, 0], v_new=v[:, 0],
                 logit_softcap=cfg.attn_logit_softcap,
+                window=cfg.sliding_window,
                 interpret=interpret,
             )
             out = out[:, None]

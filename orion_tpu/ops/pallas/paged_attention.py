@@ -59,6 +59,7 @@ def _kernel(
     K: int,
     G8: int,
     fused_write: bool,
+    window: Optional[int],
     pt_ref,        # [B, P] scalar-prefetched page table (per-layer-relative)
     base_ref,      # [1] scalar-prefetched flat-pool row base (layer * NP)
     sl_ref,        # [B] scalar-prefetched last valid position per sequence
@@ -104,8 +105,14 @@ def _kernel(
         k_src, v_src = k_ref, v_ref
 
     # Ragged skip: pages wholly beyond this sequence's context do nothing
-    # (their fetches were elided by the clamped index map).
-    @pl.when(ip * psz <= last_pos)
+    # (their fetches were elided by the clamped index map). With a sliding
+    # window, pages wholly BEHIND the window skip too (same elision via the
+    # index map's lower clamp), so compute and traffic are O(window).
+    run = ip * psz <= last_pos
+    if window is not None:
+        run &= ip * psz + psz - 1 >= last_pos - window + 1
+
+    @pl.when(run)
     def _body():
         q = q_ref[0].reshape(K, G8, H).astype(jnp.float32)
         k = k_src[0].astype(jnp.float32)                 # [K, psz, H]
@@ -120,6 +127,9 @@ def _kernel(
             jnp.int32, (K * G8, psz), 1
         )
         mask = kv_pos <= last_pos
+        if window is not None:
+            # q sits at last_pos: attend iff last_pos - kv_pos < window.
+            mask &= kv_pos >= last_pos - window + 1
         z = jnp.where(mask, z, NEG_INF)
 
         m_prev = m_s[:, :1]
@@ -144,7 +154,7 @@ def _kernel(
 
 
 def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
-          softcap, interpret):
+          softcap, window, interpret):
     B, N, H = q.shape
     rows_total, K, psz, _ = k_pool.shape
     P = page_table.shape[1]
@@ -162,8 +172,14 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
         # page: consecutive identical block requests elide the DMA, and in
         # fused-write mode the tail's write-backs then re-target the page
         # that received the new token (which re-applies its insert — see
-        # _kernel) instead of clobbering some other page.
+        # _kernel) instead of clobbering some other page. With a sliding
+        # window, pages wholly behind the window clamp UP to the window's
+        # first page the same way (their write-backs rewrite that page with
+        # its own just-fetched data — harmless), eliding their DMAs too.
         valid_ip = jnp.minimum(ip, sl[b] // psz)
+        if window is not None:
+            first = jnp.maximum(sl[b] - window + 1, 0) // psz
+            valid_ip = jnp.maximum(valid_ip, jnp.minimum(first, sl[b] // psz))
         return (bs[0] + pt[b, valid_ip], 0, 0, 0)
 
     def row_index(b, ip, pt, bs, sl):
@@ -201,7 +217,7 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, softcap, psz, K, G8, fused_write),
+        functools.partial(_kernel, softcap, psz, K, G8, fused_write, window),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
@@ -224,6 +240,8 @@ def paged_attention(
     k_new: Optional[jax.Array] = None,      # [B, K, H]: K/V of the token at
     v_new: Optional[jax.Array] = None,      #   last_pos, written in-kernel
     logit_softcap: Optional[float] = None,
+    window: Optional[int] = None,           # sliding window: attend iff
+    #                                         last_pos - kv_pos < window
     interpret: Optional[bool] = None,
 ):
     """Decode attention over the paged KV pool.
@@ -241,12 +259,14 @@ def paged_attention(
     the call sits inside a layer scan over one carried flat pool.
     """
     assert (k_new is None) == (v_new is None)
+    if window is not None and window < 1:
+        raise ValueError(f"window={window} must be >= 1")
     K = k_pool.shape[1]
     assert q.shape[1] % K == 0, (q.shape, K)
     base = jnp.asarray(layer_base, jnp.int32).reshape(1)
     attn, kp, vp = _call(
         q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
-        logit_softcap, interpret,
+        logit_softcap, window, interpret,
     )
     if k_new is None:
         return attn

@@ -341,3 +341,17 @@ def test_sample_mixed_rows():
     )
     am = np.argmax(np.asarray(logits), axis=-1)
     assert int(toks[0]) == am[0] and int(toks[2]) == am[2]
+
+
+@pytest.mark.parametrize("kernels", ["xla", "pallas_interpret"])
+def test_sliding_window_engine_matches_forward(kernels):
+    """Windowed serving (prefill + paged decode, both kernel paths) must
+    reproduce greedy generation from the windowed training forward —
+    the training/serving-semantics equivalence SWA makes easy to break."""
+    cfg, params = _setup(overrides=[
+        "model.sliding_window=6", f"model.kernels={kernels}",
+    ])
+    prompt = [5, 3, 9, 250, 17, 8, 100, 42, 77]   # context > window
+    ref = _ref_generate(params, cfg.model, prompt, 10)
+    out = InferenceEngine(cfg, params).generate([prompt], 10)[0]
+    assert out == ref

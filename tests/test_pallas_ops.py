@@ -483,3 +483,52 @@ class TestSlidingWindow:
             np.asarray(lf[:, :4]), np.asarray(lw[:, :4]), atol=1e-5
         )
         assert not np.allclose(np.asarray(lf[:, 8:]), np.asarray(lw[:, 8:]))
+
+
+@pytest.mark.parametrize("window", [5, 16, 40, 1000])
+def test_paged_attention_sliding_window(window):
+    """Windowed paged decode: pages behind the window are skipped (their
+    DMAs clamp to the window's first page) yet the result equals the
+    masked gather reference."""
+    from orion_tpu.ops.attention import attention_xla
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    N, K = 8, 2
+    B, H, psz, P, num_pages = 3, 64, 16, 4, 32
+    keys = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(keys[0], (B, N, H), jnp.float32)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.float32)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.float32)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
+    )
+    last_pos = jnp.asarray([0, 37, 63], jnp.int32)
+
+    k_ctx = k_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    v_ctx = v_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    pos = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    mask = (pos <= last_pos[:, None, None]) & (
+        pos >= (last_pos - window + 1)[:, None, None]
+    )
+    ref = attention_xla(q[:, None], k_ctx, v_ctx, causal=False, mask=mask)[
+        :, 0
+    ]
+    out = paged_attention(
+        q, k_pool, v_pool, page_table, last_pos, window=window,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_attention_rejects_degenerate_window():
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    q = jnp.zeros((1, 4, 64))
+    pool = jnp.zeros((4, 2, 16, 64))
+    with pytest.raises(ValueError, match="window"):
+        paged_attention(
+            q, pool, pool, jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros(1, jnp.int32), window=0, interpret=True,
+        )
