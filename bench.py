@@ -158,7 +158,44 @@ def bench_infer(overrides) -> int:
     return 0
 
 
+def _probe_device(timeout_s: float = 180.0) -> bool:
+    """Check the accelerator actually answers before committing to a run.
+
+    The TPU plugin can hang indefinitely inside backend init when its
+    tunnel is down (observed repeatedly on the dev box); probing in a
+    subprocess with a timeout turns that hang into a clean, fast JSON
+    error line the driver can record.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        _probe_error(
+            f"accelerator backend unresponsive after {timeout_s}s "
+            "(device tunnel down?)"
+        )
+        return False
+    if r.returncode != 0:
+        _probe_error("backend init failed: " + r.stderr.strip()[-400:])
+        return False
+    return True
+
+
+def _probe_error(msg: str) -> None:
+    # One error line per judged metric, so a consumer of the JSON sees a
+    # recorded failure for both rather than missing data for the second.
+    for metric in ("llama_flagship_train_mfu", "llama_flagship_decode_tput"):
+        print(json.dumps({"metric": metric, "error": msg}))
+
+
 def main() -> int:
+    if not _probe_device():
+        return 1
     # Silence per-step logging so stdout is exactly the JSON lines; user
     # overrides can still re-enable it.
     overrides = ["train.log_interval=100000"] + sys.argv[1:]

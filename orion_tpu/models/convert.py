@@ -80,6 +80,77 @@ def _maybe_lm_head(
     params["lm_head"] = np.ascontiguousarray(sd[head_key].T)
 
 
+def _unstack(cfg: ModelConfig, blocks: Any) -> list[Params]:
+    """Inverse of _stack: per-layer list of trees from the [L, ...] stack."""
+    import jax
+
+    if not cfg.scan_layers:
+        return list(blocks)
+    return [
+        jax.tree.map(lambda x: np.asarray(x[i]), blocks)
+        for i in range(cfg.n_layers)
+    ]
+
+
+def to_hf_llama(
+    params: Params, cfg: ModelConfig, dtype=None
+) -> dict[str, np.ndarray]:
+    """Export to the ``LlamaForCausalLM`` state-dict schema (round-trip
+    inverse of ``from_hf_llama``; Mistral shares the schema).
+
+    Load into torch with ``model.load_state_dict({k: torch.from_numpy(v)
+    for k, v in sd.items()})`` — the path back to the reference's world
+    for models trained here.
+
+    Leaves keep their native dtype unless ``dtype`` is given (a bf16
+    export arrives as ml_dtypes.bfloat16 numpy arrays; view-cast for
+    torch: ``torch.from_numpy(v.view(np.uint16)).view(torch.bfloat16)``).
+    """
+    unexportable = []
+    if cfg.attn_bias or cfg.mlp_bias:
+        unexportable.append("attention/mlp biases")
+    if cfg.pos_embedding != "rope":
+        unexportable.append(f"pos_embedding={cfg.pos_embedding!r}")
+    if cfg.norm != "rmsnorm":
+        unexportable.append(f"norm={cfg.norm!r}")
+    if cfg.activation != "swiglu":
+        unexportable.append(f"activation={cfg.activation!r}")
+    if cfg.is_moe:
+        unexportable.append("MoE experts")
+    if unexportable:
+        raise ValueError(
+            "model has no slot in the Llama state-dict schema for: "
+            + ", ".join(unexportable)
+        )
+
+    def a(x):
+        return np.asarray(x) if dtype is None else np.asarray(x, dtype)
+
+    def t(x):
+        return np.ascontiguousarray(a(x).T)
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": a(params["embed"]["tokens"]),
+        "model.norm.weight": a(params["final_norm"]["scale"]),
+    }
+    for i, b in enumerate(_unstack(cfg, params["blocks"])):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = a(b["attn_norm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = a(b["mlp_norm"]["scale"])
+        sd[p + "self_attn.q_proj.weight"] = t(b["attn"]["wq"])
+        sd[p + "self_attn.k_proj.weight"] = t(b["attn"]["wk"])
+        sd[p + "self_attn.v_proj.weight"] = t(b["attn"]["wv"])
+        sd[p + "self_attn.o_proj.weight"] = t(b["attn"]["wo"])
+        sd[p + "mlp.gate_proj.weight"] = t(b["mlp"]["w_gate"])
+        sd[p + "mlp.up_proj.weight"] = t(b["mlp"]["w_in"])
+        sd[p + "mlp.down_proj.weight"] = t(b["mlp"]["w_out"])
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = t(params["lm_head"])
+    else:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd
+
+
 def from_hf_llama(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     """Llama/Llama-2/Llama-3-family ``LlamaForCausalLM`` state dict."""
     L = cfg.n_layers

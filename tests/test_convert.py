@@ -165,3 +165,43 @@ def test_mistral_sliding_window_logits_parity():
 
     full, _ = forward(params, TOKENS, _dc.replace(cfg, sliding_window=None))
     assert not np.allclose(np.asarray(ours), np.asarray(full))
+
+
+def test_to_hf_llama_round_trip():
+    """Export: a model trained here loads into torch LlamaForCausalLM and
+    produces OUR logits — the migration path back to the reference world."""
+    from orion_tpu.models import init_params
+    from orion_tpu.models.convert import to_hf_llama
+
+    cfg = ModelConfig(
+        name="export-tiny", vocab_size=256, max_seq_len=64, d_model=64,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        rope_theta=10_000.0, norm_eps=1e-5, tie_embeddings=False,
+        dtype="float32", param_dtype="float32",
+    )
+    params = init_params(cfg, jax.random.key(5))
+    ours, _ = forward(params, TOKENS, cfg)
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10_000.0,
+        tie_word_embeddings=False, attention_bias=False,
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    sd = {k: torch.from_numpy(v) for k, v in to_hf_llama(params, cfg).items()}
+    hf.load_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(ours), _hf_logits(hf, TOKENS), atol=2e-4, rtol=1e-3
+    )
+
+
+def test_to_hf_llama_rejects_non_llama_configs():
+    from orion_tpu.models import init_params
+    from orion_tpu.models.convert import to_hf_llama
+    from orion_tpu.config import get_config
+
+    cfg = get_config("tiny").model  # GPT-2 family: learned pos, LN, biases
+    params = init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="no slot"):
+        to_hf_llama(params, cfg)
