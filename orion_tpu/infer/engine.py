@@ -44,6 +44,7 @@ class Request:
     pages: list[int] = field(default_factory=list)
     done: bool = False
     admit_seq: int = -1   # admission order; preemption evicts the youngest
+    freed_until: int = 0  # logical pages below this are freed (SWA rolling)
 
     @property
     def context(self) -> list[int]:
@@ -169,11 +170,11 @@ class InferenceEngine:
         # pre-provisioned pages: the device may write up to W-1 positions
         # past the host's final accepted token (see runner.decode_window).
         max_context = min(len(prompt) + max(max_new, 0), limit)
-        worst = min(max_context + self.icfg.decode_window, limit)
-        needed = max(
-            self._bucket_len(max_context),
-            -(-worst // self.psz) * self.psz,
-        ) // self.psz + 1
+        # Worst admission demand over every context the request could
+        # (re-)prefill at — with a sliding window the peak sits at a
+        # prefill-bucket bottom, not at max_context (see
+        # _worst_admission_need).
+        needed = self._worst_admission_need(len(prompt), max_context)
         usable = self.icfg.num_pages - 1
         if needed > usable:
             raise ValueError(
@@ -260,6 +261,95 @@ class InferenceEngine:
         chunk = self.icfg.prefill_chunk
         return min(-(-n // chunk) * chunk, self.icfg.max_seq_len)
 
+    def _admission_need(self, context_len: int) -> tuple[int, int, int]:
+        """(n_pages, first_live, need): the pool demand of admitting a
+        request whose context is ``context_len`` tokens.
+
+        ``need`` covers the prefill's real (live) pages plus the first
+        decode window's pre-provisioning — the exact check _admit applies;
+        submit() maxes it over every context the request could re-prefill
+        at so the pool-holds-this-request-alone invariant stays true.
+        """
+        n_pages = self._bucket_len(context_len) // self.psz
+        first_live = self._first_live_page(context_len)
+        n_real = n_pages - first_live
+        last = min(
+            context_len + self.icfg.decode_window - 1,
+            self.icfg.max_seq_len - 1,
+        )
+        first_window = min(last // self.psz + 1, self.pages_per_seq)
+        # +1 spare on both branches: mid-decode pool exhaustion must stay
+        # unreachable for a request the pool holds alone.
+        need = max(n_real + 1, first_window - first_live + 1)
+        return n_pages, first_live, need
+
+    def _worst_admission_need(self, min_ctx: int, max_ctx: int) -> int:
+        """Max admission need over every context in [min_ctx, max_ctx].
+
+        Exact vectorized sweep: with a sliding window the demand is not
+        monotone in context (bucket size is a step function while the
+        dead-page count advances every page_size tokens), and the peak
+        sits at a prefill-bucket bottom — not at max_ctx, where a
+        candidate-point check would look.
+        """
+        icfg = self.icfg
+        W, Wd, psz = self.mcfg.sliding_window, icfg.decode_window, self.psz
+        ctxs = np.arange(min_ctx, max_ctx + 1, dtype=np.int64)
+        chunk = icfg.prefill_chunk
+        bucket = np.minimum(-(-ctxs // chunk) * chunk, icfg.max_seq_len)
+        first_live = (
+            np.maximum(ctxs - W + 1, 0) // psz
+            if W is not None
+            else np.zeros_like(ctxs)
+        )
+        n_real = bucket // psz - first_live
+        last = np.minimum(ctxs + Wd - 1, icfg.max_seq_len - 1)
+        first_window = np.minimum(last // psz + 1, self.pages_per_seq)
+        need = np.maximum(n_real + 1, first_window - first_live + 1)
+        return int(need.max())
+
+    def _first_live_page(self, context_len: int) -> int:
+        """First logical page a sequence at ``context_len`` can still read.
+
+        With sliding-window attention the next decode query (position
+        ``context_len``) attends kv positions > context_len - window; pages
+        wholly before that are dead — never allocated at admission, and
+        freed as the window rolls past them (_roll_window). 0 without SWA.
+        """
+        W = self.mcfg.sliding_window
+        if W is None:
+            return 0
+        return max(context_len - W + 1, 0) // self.psz
+
+    def _roll_window(self) -> None:
+        """Return dead pages (behind the sliding window) to the pool.
+
+        The decode mask and the paged kernel's index clamp both exclude
+        them, so a windowed sequence's steady-state footprint is
+        O(window), not O(context). Freed logical slots keep a None
+        placeholder so page indices stay position-aligned; their table
+        entries point at scratch page 0 (never read)."""
+        if self.mcfg.sliding_window is None:
+            return
+        for req in self.slots:
+            if req is None or req.slot is None:
+                continue
+            first = min(
+                self._first_live_page(int(self.seq_lens[req.slot])),
+                len(req.pages),
+            )
+            if first <= req.freed_until:
+                continue  # nothing newly dead since the last pass
+            dead = [
+                p for p in req.pages[req.freed_until:first] if p is not None
+            ]
+            for j in range(req.freed_until, first):
+                req.pages[j] = None
+            self.page_table[req.slot, req.freed_until:first] = 0
+            req.freed_until = first
+            if dead:
+                self.alloc.free(dead)
+
     def _admit(self) -> None:
         # Pass 1 (host): claim slots + pages for every admissible request,
         # preserving arrival order (head-of-line blocking on resources).
@@ -280,24 +370,23 @@ class InferenceEngine:
                 break
             context = req.context
             s_pad = self._bucket_len(len(context))
-            n_pages = s_pad // self.psz
-            # Reserve enough for the prefill AND the first decode window's
-            # pre-provisioning (positions up to context+W-1): admitting on
-            # the prefill footprint alone would let _grow_pages preempt the
-            # request right back out in the same step when W > page_size.
-            last = min(
-                len(context) + self.icfg.decode_window - 1,
-                self.icfg.max_seq_len - 1,
-            )
-            first_window = min(last // self.psz + 1, self.pages_per_seq)
-            need = max(n_pages + 1, first_window)
+            # Sliding window: logical pages wholly behind the window are
+            # dead on arrival (decode will never read them) — their table
+            # entries point at scratch page 0 and no pool page is spent.
+            # `need` also reserves the first decode window's
+            # pre-provisioning: admitting on the prefill footprint alone
+            # would let _grow_pages preempt the request right back out in
+            # the same step when decode_window > page_size.
+            n_pages, first_live, need = self._admission_need(len(context))
+            n_real = n_pages - first_live
             if self.alloc.free_pages - reserved < need:
                 break  # head-of-line blocking: keep arrival order
-            reserved += need - n_pages
+            reserved += need - n_real
             self.waiting.popleft()
             req.slot = slot
             req.admit_seq = next(self._admit_seq)
-            req.pages = self.alloc.alloc(n_pages)
+            req.pages = [None] * first_live + self.alloc.alloc(n_real)
+            req.freed_until = first_live
             self.slots[slot] = req
             icfg = self.icfg
             self.slot_temp[slot] = (
@@ -310,7 +399,9 @@ class InferenceEngine:
             self.slot_top_p[slot] = (
                 icfg.top_p if req.top_p is None else req.top_p
             )
-            self.page_table[slot, :n_pages] = req.pages
+            self.page_table[slot, :n_pages] = [
+                0 if p is None else p for p in req.pages
+            ]
             self.seq_lens[slot] = len(context)
             admitted.append((req, s_pad))
 
@@ -334,7 +425,9 @@ class InferenceEngine:
             context = req.context
             tokens[i, : len(context)] = context
             lengths[i] = len(context)
-            pages[i] = req.pages
+            # Dead (behind-window) logical pages write to scratch page 0;
+            # those positions are never read back (sliding-window mask).
+            pages[i] = [0 if p is None else p for p in req.pages]
         logits, self.cache = self._prefill(
             self.params,
             self.cache,
@@ -358,8 +451,9 @@ class InferenceEngine:
         log.info("preempting request %d (pool pressure)", req.rid)
         self.preemptions += 1
         slot = req.slot
-        self.alloc.free(req.pages)
+        self.alloc.free([p for p in req.pages if p is not None])
         req.pages = []
+        req.freed_until = 0
         req.slot = None
         self.slots[slot] = None
         self.page_table[slot] = 0
@@ -401,6 +495,7 @@ class InferenceEngine:
                 req.pages.append(page)
 
     def _decode_all(self) -> None:
+        self._roll_window()
         self._grow_pages()
         active = [r for r in self.slots if r is not None and not r.done]
         if not active:
@@ -492,7 +587,7 @@ class InferenceEngine:
     def _reap(self) -> None:
         for i, req in enumerate(self.slots):
             if req is not None and req.done:
-                self.alloc.free(req.pages)
+                self.alloc.free([p for p in req.pages if p is not None])
                 req.pages = []
                 self.slots[i] = None
                 self.page_table[i] = 0

@@ -117,6 +117,10 @@ def to_hf_llama(
         unexportable.append(f"activation={cfg.activation!r}")
     if cfg.is_moe:
         unexportable.append("MoE experts")
+    if cfg.attn_logit_softcap is not None:
+        # Part of the attention math, not the weights: the export would
+        # load cleanly and silently produce different logits.
+        unexportable.append("attn_logit_softcap")
     if unexportable:
         raise ValueError(
             "model has no slot in the Llama state-dict schema for: "

@@ -205,3 +205,16 @@ def test_to_hf_llama_rejects_non_llama_configs():
     params = init_params(cfg, jax.random.key(0))
     with pytest.raises(ValueError, match="no slot"):
         to_hf_llama(params, cfg)
+
+
+def test_to_hf_llama_rejects_softcap():
+    from orion_tpu.models import init_params
+    from orion_tpu.models.convert import to_hf_llama
+
+    cfg = ModelConfig(
+        name="t", vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=64, tie_embeddings=False,
+        attn_logit_softcap=50.0, dtype="float32", param_dtype="float32",
+    )
+    with pytest.raises(ValueError, match="softcap"):
+        to_hf_llama(init_params(cfg, jax.random.key(0)), cfg)

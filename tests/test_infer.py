@@ -355,3 +355,50 @@ def test_sliding_window_engine_matches_forward(kernels):
     ref = _ref_generate(params, cfg.model, prompt, 10)
     out = InferenceEngine(cfg, params).generate([prompt], 10)[0]
     assert out == ref
+
+
+def test_rolling_window_bounds_page_footprint():
+    """SWA serving is O(window) in pages: a pool too small for the full
+    context (old behavior: single-request MemoryError) serves a long
+    windowed generation correctly because dead pages are never allocated
+    at admission and roll back to the pool as the window advances."""
+    cfg, params = _setup(overrides=[
+        "model.sliding_window=20",
+        "inference.num_pages=6",         # 5 usable < 7 full-context pages
+        "inference.max_new_tokens=90",
+    ])
+    prompt = [(i * 13) % 250 + 1 for i in range(10)]
+    ref = _ref_generate(params, cfg.model, prompt, 90)
+
+    eng = InferenceEngine(cfg, params)
+    out = eng.generate([prompt], 90)[0]
+    assert out == ref
+    assert eng.preemptions == 0
+    # All pages returned after completion.
+    assert eng.alloc.free_pages == cfg.inference.num_pages - 1
+
+
+def test_windowed_submit_accounts_for_bucket_bottom_peak():
+    """The singleton-footprint check must use the WORST context (a
+    prefill-bucket bottom), not max_context: a request accepted by submit
+    but unadmittable would hang generate() forever."""
+    cfg, params = _setup(overrides=[
+        "model.sliding_window=4096",
+        "inference.max_seq_len=8192", "inference.page_size=64",
+        "inference.prefill_chunk=512", "inference.num_pages=72",
+        "inference.max_batch_size=2",
+    ])
+    eng = InferenceEngine(cfg, params)
+    prompt = [1] * 5633
+    # Worst re-prefill (bucket 6144 -> 96 logical pages, only 24 dead)
+    # needs ~73 real pages > 71 usable: must reject at submit, not hang.
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit(prompt, 500)
+    # A big enough pool accepts the same request.
+    cfg2, _ = _setup(overrides=[
+        "model.sliding_window=4096",
+        "inference.max_seq_len=8192", "inference.page_size=64",
+        "inference.prefill_chunk=512", "inference.num_pages=80",
+        "inference.max_batch_size=2",
+    ])
+    InferenceEngine(cfg2, params).submit(prompt, 500)
