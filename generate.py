@@ -77,8 +77,15 @@ def main(argv: list[str] | None = None) -> int:
         if restored is not None:
             params = restored[0]["params"]
             print(f"restored checkpoint step {restored[1]}")
+            # Drop the rest of the train state (optimizer moments are 2x
+            # the params) before the engine possibly quantizes.
+            del restored
 
     engine = InferenceEngine(cfg, params, eos_id=args.eos_id)
+    # The engine owns (a possibly int8-quantized copy of) the params from
+    # here; keeping this reference alive would pin the full-precision
+    # masters in device memory for the whole serving loop.
+    del params
     if args.stream:
         collected: dict[int, list[int]] = {}
         for rid, toks in engine.stream(prompts, args.max_new_tokens):

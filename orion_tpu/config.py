@@ -72,6 +72,12 @@ class ModelConfig:
     # "xla" uses the pure-jnp reference path (also the CPU/test path).
     kernels: str = "xla"
 
+    # Weight-only quantization for SERVING ("int8" | None): the inference
+    # engine quantizes the given params at init (per-channel scales,
+    # models/quantize.py) — decode is HBM-bound, so halving param bytes
+    # nearly doubles the decode roofline. Training rejects the flag.
+    weight_quant: Optional[str] = None
+
     # Flash-attention tile sizes (pallas only). None => auto: large tiles
     # (up to 1024) amortize the online-softmax bookkeeping on the MXU; the
     # v5e microbench (bench_r3 notes) puts 1024x1024 at ~2.3x the xla

@@ -76,6 +76,14 @@ class InferenceEngine:
         self.cfg = cfg
         self.mcfg = cfg.model
         self.icfg = cfg.inference
+        if self.mcfg.weight_quant == "int8":
+            from orion_tpu.models.quantize import quantize_params
+
+            params = quantize_params(params, self.mcfg)
+        elif self.mcfg.weight_quant is not None:
+            raise ValueError(
+                f"unknown model.weight_quant={self.mcfg.weight_quant!r}"
+            )
         self.params = params
         self.eos_id = eos_id
         self.psz = self.icfg.page_size

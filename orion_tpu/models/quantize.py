@@ -1,0 +1,83 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: every step re-reads all params plus the
+active KV pages (PERF.md "Serving line"). Int8 weights halve the param
+bytes against bf16 — near-2x the decode roofline — at a per-channel
+quantization error the logits tests bound. Training never sees this:
+``model.weight_quant`` is a serving knob; the engine quantizes the given
+(bf16/f32) params at init and the trainer rejects the flag.
+
+Representation: each quantized matmul weight becomes a ``{"q": int8
+[in, out], "s": f32 [out]}`` subtree (per-output-channel symmetric
+scales); ``models.transformer`` dequantizes at use via ``load_weight``
+(XLA fuses the convert+scale into the matmul operand read, so the wire
+win survives compilation). Embeddings stay full precision (gather
+quality, and the tied unembedding reuses them); MoE expert banks are
+left unquantized for now (expert-sharded layouts want per-expert scale
+handling — a later knob).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+
+Params = dict[str, Any]
+
+# Block-level weight names eligible for int8 (matmul weights only —
+# never norms scales, biases, or embeddings).
+_QUANT_KEYS = frozenset({"wq", "wk", "wv", "wo", "w_in", "w_gate", "w_out"})
+
+
+def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
+    """[..., in, out] float -> {"q": int8 [..., in, out], "s": f32 [..., out]}.
+
+    The reduction axis is the contraction (``in``) dim — axis -2 — so the
+    same code serves flat [in, out] weights and scan-stacked [L, in, out]
+    weights (per-layer, per-output-channel scales).
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(wf / s[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def load_weight(w: Any, dtype) -> jax.Array:
+    """Dequantize-on-use: the single read path for maybe-quantized weights."""
+    if isinstance(w, dict) and "q" in w:
+        return w["q"].astype(dtype) * w["s"][..., None, :].astype(dtype)
+    return w.astype(dtype)
+
+
+def quantize_params(params: Params, cfg: ModelConfig) -> Params:
+    """Quantize every eligible matmul weight in the parameter pytree."""
+
+    def convert(tree: Params, *, in_attn_or_mlp: bool) -> Params:
+        out: Params = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = convert(
+                    v, in_attn_or_mlp=k in ("attn", "mlp") or in_attn_or_mlp
+                )
+            elif in_attn_or_mlp and k in _QUANT_KEYS:
+                out[k] = quantize_weight(v)
+            else:
+                out[k] = v
+        return out
+
+    out = dict(params)
+    blocks = params["blocks"]
+    if isinstance(blocks, list):
+        out["blocks"] = [convert(b, in_attn_or_mlp=False) for b in blocks]
+    else:
+        out["blocks"] = convert(blocks, in_attn_or_mlp=False)
+    if "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from orion_tpu import ops
 from orion_tpu.config import ModelConfig
 from orion_tpu.models import moe as moe_lib
+from orion_tpu.models.quantize import load_weight as _load_w
 
 Params = dict[str, Any]
 
@@ -199,7 +200,7 @@ def unembed(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
             "bsd,vd->bsv", x, params["embed"]["tokens"].astype(x.dtype)
         )
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype))
+        logits = jnp.einsum("bsd,dv->bsv", x, _load_w(params["lm_head"], x.dtype))
     return logits.astype(jnp.float32)
 
 
@@ -215,9 +216,9 @@ def qkv_proj(
     N, K, H = cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim
     dtype = x.dtype
 
-    q = jnp.einsum("bsd,dh->bsh", x, p["wq"].astype(dtype))
-    k = jnp.einsum("bsd,dh->bsh", x, p["wk"].astype(dtype))
-    v = jnp.einsum("bsd,dh->bsh", x, p["wv"].astype(dtype))
+    q = jnp.einsum("bsd,dh->bsh", x, _load_w(p["wq"], dtype))
+    k = jnp.einsum("bsd,dh->bsh", x, _load_w(p["wk"], dtype))
+    v = jnp.einsum("bsd,dh->bsh", x, _load_w(p["wv"], dtype))
     if cfg.attn_bias:
         q = q + p["bq"].astype(dtype)
         k = k + p["bk"].astype(dtype)
@@ -237,7 +238,7 @@ def out_proj(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     B, S = out.shape[0], out.shape[1]
     dtype = out.dtype
     y = jnp.einsum(
-        "bsh,hd->bsd", out.reshape(B, S, -1), p["wo"].astype(dtype)
+        "bsh,hd->bsd", out.reshape(B, S, -1), _load_w(p["wo"], dtype)
     )
     if cfg.attn_bias:
         y = y + p["bo"].astype(dtype)
@@ -318,15 +319,15 @@ def _attn_block(
 
 def _mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     dtype = x.dtype
-    h_in = jnp.einsum("bsd,df->bsf", x, p["w_in"].astype(dtype))
+    h_in = jnp.einsum("bsd,df->bsf", x, _load_w(p["w_in"], dtype))
     if cfg.mlp_bias:
         h_in = h_in + p["b_in"].astype(dtype)
     if cfg.activation == "swiglu":
-        h_gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(dtype))
+        h_gate = jnp.einsum("bsd,df->bsf", x, _load_w(p["w_gate"], dtype))
         h = jax.nn.silu(h_gate) * h_in
     else:
         h = jax.nn.gelu(h_in)
-    y = jnp.einsum("bsf,fd->bsd", h, p["w_out"].astype(dtype))
+    y = jnp.einsum("bsf,fd->bsd", h, _load_w(p["w_out"], dtype))
     if cfg.mlp_bias:
         y = y + p["b_out"].astype(dtype)
     return y

@@ -238,6 +238,11 @@ class Trainer:
     def __init__(self, cfg: Config):
         import dataclasses as _dc
 
+        if cfg.model.weight_quant is not None:
+            raise ValueError(
+                "model.weight_quant is a serving-only knob (the engine "
+                "quantizes at init); training runs full-precision masters"
+            )
         if cfg.data.packed and cfg.parallel.pp > 1:
             raise ValueError(
                 "data.packed is incompatible with parallel.pp: pipeline "
