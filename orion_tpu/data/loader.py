@@ -57,6 +57,12 @@ def pack_rows(
     Segment id 0 is reserved for padding (matches the kernel's convention
     that distinct ids never attend to each other; padding rows also carry
     loss_mask 0 so their nll is dropped).
+
+    A document that crosses a row boundary is split, not truncated: the
+    untrained remainder (from the last consumed input token onward, so no
+    pair is dropped or duplicated) carries over to the front of the next
+    row. Only the final row's overhang is dropped — O(1) tokens per batch
+    instead of O(docs).
     """
     B = len(docs_per_row)
     inputs = np.zeros((B, seq_len), np.int32)
@@ -64,21 +70,29 @@ def pack_rows(
     segments = np.zeros((B, seq_len), np.int32)
     positions = np.zeros((B, seq_len), np.int32)
     mask = np.zeros((B, seq_len), np.float32)
+    carry: list[np.ndarray] = []  # docs (or tails) displaced into the next row
     for b, docs in enumerate(docs_per_row):
-        at = 0
-        for s, doc in enumerate(docs):
+        at, seg = 0, 0
+        queue, carry = carry + list(docs), []
+        for doc in queue:
             doc = np.asarray(doc)
-            if at >= seq_len:
-                break          # row is full
             if len(doc) < 2:
                 continue       # degenerate doc: skip, keep packing the rest
+            if at >= seq_len:
+                carry.append(doc)  # row already full: displace whole doc
+                continue
             n = min(len(doc) - 1, seq_len - at)  # pairs, not tokens
+            seg += 1
             inputs[b, at : at + n] = doc[:n]
             targets[b, at : at + n] = doc[1 : n + 1]
-            segments[b, at : at + n] = s + 1
+            segments[b, at : at + n] = seg
             positions[b, at : at + n] = np.arange(n)
             mask[b, at : at + n] = 1.0
             at += n
+            if n < len(doc) - 1:
+                # Truncated mid-document: resume at token n so the next row
+                # trains the pair (doc[n] -> doc[n+1]) and nothing is lost.
+                carry.append(doc[n:])
     return {
         "inputs": inputs,
         "targets": targets,
@@ -177,7 +191,10 @@ class MemmapLoader(Loader):
                     for a, b in zip(bounds[:-1], bounds[1:])
                     if b - a >= 2
                 ]
-                docs_per_row.append(docs or [row])
+                # If no span has >=2 tokens (e.g. a run of EOS), emit an
+                # empty doc list: pack_rows leaves the row fully masked
+                # rather than training attention/loss across EOS boundaries.
+                docs_per_row.append(docs)
             return pack_rows(docs_per_row, s)
         return {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
 

@@ -160,8 +160,36 @@ class InferenceEngine:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
     ) -> int:
+        """Queue a request; returns its id.
+
+        Note: any non-None sampling override switches the WHOLE decode batch
+        to the sort-based sampling program (a [B, V] sort per token for every
+        co-scheduled slot, plus a one-time second decode compile) until no
+        overriding request remains active — overrides cost throughput for the
+        batch, not just this request. Greedy-default traffic stays on the
+        sort-free specialized program.
+        """
         if not len(prompt):
             raise ValueError("empty prompt")
+        if temperature is not None and temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and not 0 <= top_k <= self.mcfg.vocab_size:
+            raise ValueError(
+                f"top_k must be in [0, vocab_size={self.mcfg.vocab_size}], "
+                f"got {top_k} (0 disables the top-k filter)"
+            )
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # Normalize overrides equal to the engine defaults back to None: a
+        # request that explicitly passes the default values is sampling-
+        # identical to one passing nothing, and must not push the batch onto
+        # the sort-based decode program.
+        if temperature is not None and temperature == self.icfg.temperature:
+            temperature = None
+        if top_k is not None and top_k == self.icfg.top_k:
+            top_k = None
+        if top_p is not None and top_p == self.icfg.top_p:
+            top_p = None
         limit = self.icfg.max_seq_len
         if len(prompt) >= limit:
             raise ValueError(f"prompt length {len(prompt)} >= max_seq_len {limit}")

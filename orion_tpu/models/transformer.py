@@ -274,13 +274,11 @@ def _attn_block(
         and mesh.shape.get(cfg.sequence_axis, 1) > 1
     )
     if sp_active:
-        if cfg.sliding_window is not None:
-            raise ValueError(
-                "model.sliding_window is not supported with sequence "
-                "parallelism (the ring/Ulysses paths attend full context)"
-            )
         from orion_tpu.parallel.sequence import sequence_attention
 
+        # sliding_window threads through every SP method; under "ring" it
+        # also truncates the ring scan to O(window) comm — the combination
+        # SWA exists for (long-context Mistral-family training).
         out = sequence_attention(
             q,
             k,
@@ -292,6 +290,7 @@ def _attn_block(
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
             logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.sliding_window,
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,

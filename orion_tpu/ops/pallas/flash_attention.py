@@ -498,19 +498,21 @@ def flash_attention_with_lse(
     interpret: Optional[bool] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention returning ``(out, lse)``; the blockwise unit of ring
     attention (parallel/sequence.py merges partial outputs via their lse).
 
     out: [B, Sq, N, H] in q.dtype; lse: [B, N, Sq] float32, ``-inf`` on rows
     where nothing was attended (fully masked). Differentiable in both
-    outputs. ``q_positions``/``kv_positions`` as in ``flash_attention``
-    (striped ring layouts pass the stripes' global positions).
+    outputs. ``q_positions``/``kv_positions`` and ``window`` as in
+    ``flash_attention`` (ring layouts pass blocks' global positions so the
+    sliding window measures true sequence distance).
     """
     st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq = _prep(
         q, k, v, q_segment_ids, kv_segment_ids,
         causal, logit_softcap, q_offset, block_q, block_kv, interpret,
-        q_positions, kv_positions,
+        q_positions, kv_positions, window,
     )
     o, lse = _flash_lse(st, qt, kt, vt, qseg, kseg, qpos, kpos)
     o = o[:, :, :Sq, :].transpose(0, 2, 1, 3)

@@ -62,6 +62,7 @@ def _block_attend(
     logit_softcap: Optional[float],
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Attention of local queries against one KV block.
 
@@ -69,7 +70,8 @@ def _block_attend(
     normalized within the block, and lse [b, n, sq] f32, the log-sum-exp of
     the block's logits; -inf rows mean "nothing attended here").
     Causal masking uses explicit ``q_positions``/``kv_positions`` ([sq]/
-    [skv]) when given (striped layouts), else index + offset.
+    [skv]) when given (striped layouts), else index + offset. ``window``
+    (requires causal) keeps only pairs with 0 <= q_pos - kv_pos < window.
     """
     n_heads, head_dim = q.shape[2], q.shape[3]
     k = _gqa_expand(k, n_heads)
@@ -89,8 +91,13 @@ def _block_attend(
         else:
             q_pos = q_offset + jnp.arange(q.shape[1])
             kv_pos = kv_offset + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= kv_pos[None, :]          # [sq, skv]
+        dist = q_pos[:, None] - kv_pos[None, :]           # [sq, skv]
+        mask = dist >= 0
+        if window is not None:
+            mask &= dist < window
         mask = mask[None, None]                           # [1, 1, sq, skv]
+    elif window is not None:
+        raise ValueError("window requires causal attention")
     if q_segment_ids is not None:
         seg = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
         mask = seg if mask is None else (mask & seg)
@@ -137,12 +144,19 @@ def _merge_blocks(
 # ---------------------------------------------------------------------------
 
 
-def _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend):
-    """Shared ring skeleton: attend the local block, then exactly sp-1
-    rotate->attend->merge steps (no trailing rotation whose result is
-    discarded). ``attend(k, v, seg, src, is_first)`` returns (o_f32, lse);
-    ``is_first`` is static (True only for the local step-0 block, where
-    src == idx by construction)."""
+def _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend, n_steps=None):
+    """Shared ring skeleton: attend the local block, then ``n_steps``
+    (default sp-1) rotate->attend->merge steps (no trailing rotation whose
+    result is discarded). ``attend(k, v, seg, src, is_first)`` returns
+    (o_f32, lse); ``is_first`` is static (True only for the local step-0
+    block, where src == idx by construction).
+
+    ``n_steps < sp-1`` statically truncates the ring: with a sliding window
+    over contiguous blocks, every device's step-t source block sits exactly
+    t*s_loc positions back, so steps wholly behind the window are dead for
+    ALL devices at once — dropping them removes their ppermutes entirely
+    (O(window) communication, not O(S)), not just their matmuls."""
+    n_steps = sp - 1 if n_steps is None else n_steps
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     o_acc, l_acc = attend(k, v, seg0, idx, True)
 
@@ -157,9 +171,9 @@ def _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend):
         o, l = _merge_blocks(o, l, o_blk, l_blk)
         return (k_cur, v_cur, seg_cur, o, l), None
 
-    if sp > 1:
+    if n_steps > 0:
         (_, _, _, o_acc, _), _ = lax.scan(
-            step, (k, v, seg0, o_acc, l_acc), jnp.arange(1, sp)
+            step, (k, v, seg0, o_acc, l_acc), jnp.arange(1, n_steps + 1)
         )
     return o_acc
 
@@ -177,25 +191,47 @@ def _ring_attention_local(
     impl: str = "xla",
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-device ring attention body (runs inside shard_map).
 
     Under ``impl='pallas'`` the blockwise unit is the fused flash kernel via
     ``flash_attention_with_lse`` (the lse output feeds the ring merge); under
-    'xla' it is the jnp math in _block_attend. Every ring position needs only
-    a *static* mask config — the local diagonal block is causal at relative
-    offset 0, fully-past blocks are unmasked, fully-future blocks are skipped
-    — so the kernel never needs a traced q_offset.
+    'xla' it is the jnp math in _block_attend. Without a window, every ring
+    position needs only a *static* mask config — the local diagonal block is
+    causal at relative offset 0, fully-past blocks are unmasked, fully-future
+    blocks are skipped — so the kernel never needs a traced q_offset.
+
+    With ``window`` (sliding-window / Mistral long-context), past blocks
+    carry their true global positions (idx/src * s_loc + iota) so the kernel
+    masks by real sequence distance, and the ring scan is statically
+    truncated to the steps that can reach the window at all (see _ring_scan):
+    both the compute and the ppermute traffic become O(window), independent
+    of the global sequence length.
     """
     from orion_tpu.ops._dispatch import resolve_impl
 
     use_pallas, interpret = resolve_impl(impl)
     sp = lax.axis_size(axis)
     idx = lax.axis_index(axis)
+    s_loc = q.shape[1]
     has_seg = q_seg is not None
+    windowed = causal and window is not None
 
-    def block(k_, v_, seg_, diag: bool):
-        """Attend local q against one KV block; diag => causally masked."""
+    def block(k_, v_, seg_, src, diag: bool):
+        """Attend local q against one KV block; diag => causally masked.
+
+        Past blocks (diag=False) are unmasked — unless a window is active,
+        in which case they attend causally by explicit global positions
+        (causality is vacuous there since every kv precedes every q; the
+        positions exist to measure the window distance).
+        """
+        qpos = kvpos = None
+        if windowed and not diag:
+            iota = jnp.arange(s_loc, dtype=jnp.int32)
+            qpos = idx * s_loc + iota
+            kvpos = src * s_loc + iota
+        blk_causal = causal and (diag or windowed)
         if use_pallas:
             from orion_tpu.ops.pallas.flash_attention import (
                 flash_attention_with_lse,
@@ -203,22 +239,27 @@ def _ring_attention_local(
 
             o, lse = flash_attention_with_lse(
                 q, k_, v_,
-                causal=causal and diag,
+                causal=blk_causal,
                 q_segment_ids=q_seg if has_seg else None,
                 kv_segment_ids=seg_ if has_seg else None,
                 logit_softcap=logit_softcap,
                 block_q=block_q,
                 block_kv=block_kv,
                 interpret=interpret,
+                q_positions=qpos,
+                kv_positions=kvpos,
+                window=window if windowed else None,
             )
             return o.astype(jnp.float32), lse
         zero = jnp.zeros((), jnp.int32)
         return _block_attend(
             q, k_, v_,
-            q_offset=zero, kv_offset=zero, causal=causal and diag,
+            q_offset=zero, kv_offset=zero, causal=blk_causal,
             q_segment_ids=q_seg if has_seg else None,
             kv_segment_ids=seg_ if has_seg else None,
             logit_softcap=logit_softcap,
+            q_positions=qpos, kv_positions=kvpos,
+            window=window if windowed else None,
         )
 
     def empty(kv):
@@ -235,16 +276,23 @@ def _ring_attention_local(
         # (The compute skew this leaves across the ring is what
         # method="ring_striped" fixes.)
         if is_first or not causal:
-            return block(k_, v_, seg_, is_first and causal)
+            return block(k_, v_, seg_, src, is_first and causal)
         return lax.cond(
             src < idx,
-            lambda kv: block(*kv, False),
+            lambda kv: block(*kv, src, False),
             empty,
             (k_, v_, seg_),
         )
 
+    n_steps = None
+    if windowed:
+        # Step t's source block ends at global position (idx-t+1)*s_loc - 1;
+        # its nearest pair distance to local q is (t-1)*s_loc + 1. Steps with
+        # (t-1)*s_loc + 1 >= window are dead for every device: truncate.
+        n_steps = min(sp - 1, max(0, (window - 2) // s_loc + 1))
+
     seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
-    o_acc = _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend)
+    o_acc = _ring_scan(k, v, seg0, has_seg, axis, sp, idx, attend, n_steps)
     return o_acc.astype(q.dtype)
 
 
@@ -261,6 +309,7 @@ def _ring_striped_local(
     impl: str = "xla",
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Load-balanced ("zigzag-class") ring attention body.
 
@@ -279,6 +328,13 @@ def _ring_striped_local(
     min/max block-skip preserves the 2x causal saving). One inverse
     all_to_all restores the contiguous layout afterwards, so callers see
     identical semantics to plain ring.
+
+    ``window`` composes naturally here: the explicit positions already
+    measure true sequence distance, so it passes straight to the blockwise
+    unit, and behind-window stripes fall out via the kernel's dynamic
+    block-skip. (Unlike plain ring, no ring STEP can be truncated — every
+    step's stripes span the whole sequence — so windowed long-context
+    training prefers method="ring"; this path keeps the load balance.)
     """
     from orion_tpu.ops._dispatch import resolve_impl
 
@@ -333,6 +389,7 @@ def _ring_striped_local(
                 interpret=interpret,
                 q_positions=qpos if causal else None,
                 kv_positions=kvpos if causal else None,
+                window=window if causal else None,
             )
             return o.astype(jnp.float32), lse
         zero = jnp.zeros((), jnp.int32)
@@ -344,6 +401,7 @@ def _ring_striped_local(
             logit_softcap=logit_softcap,
             q_positions=qpos if causal else None,
             kv_positions=kvpos if causal else None,
+            window=window if causal else None,
         )
 
     seg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
@@ -371,10 +429,13 @@ def _ulysses_local(
     impl: str = "xla",
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-device Ulysses body: a2a to full-seq / sharded-heads, attend, a2a
     back (runs inside shard_map). ``impl`` selects the local attention kernel
-    (the Pallas flash kernel under impl='pallas')."""
+    (the Pallas flash kernel under impl='pallas'); ``window`` passes straight
+    to it (the local view is the full sequence, so index distance is true
+    sequence distance)."""
     from orion_tpu.ops.attention import attention
 
     sp = lax.axis_size(axis)
@@ -391,6 +452,7 @@ def _ulysses_local(
         q_segment_ids=q_seg,
         kv_segment_ids=kv_seg,
         logit_softcap=logit_softcap,
+        window=window,
         block_q=block_q,
         block_kv=block_kv,
         impl=impl,
@@ -427,6 +489,7 @@ def sequence_attention(
     impl: str = "xla",
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel grouped-query causal attention.
 
@@ -439,9 +502,17 @@ def sequence_attention(
                         head-preserving seq all_to_all each way); equalizes
                         the causal skew across devices. Needs S % sp^2 == 0.
       - "ulysses":      head<->sequence all_to_all; K % (sp*tp) == 0.
+
+    ``window`` (sliding-window / Mistral-family, requires causal) composes
+    with every method; under "ring" both compute and ppermute traffic shrink
+    to O(window) via static ring-step truncation (see _ring_attention_local).
     """
     if method not in ("ring", "ring_striped", "ulysses"):
         raise ValueError(f"unknown sequence method {method!r}")
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal attention and window >= 1"
+        )
     sp = mesh.shape.get(axis, 1)
     if method == "ulysses":
         tp = mesh.shape.get(head_axis, 1) if head_axis else 1
@@ -473,7 +544,7 @@ def sequence_attention(
     }[method]
     fn = partial(
         body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl,
-        block_q=block_q, block_kv=block_kv,
+        block_q=block_q, block_kv=block_kv, window=window,
     )
     qkv_spec, seg_spec = _specs(axis, batch_axes, head_axis)
 

@@ -122,6 +122,37 @@ def test_pack_rows_invariants():
     assert b["loss_mask"][1].sum() == 10
 
 
+def test_pack_rows_carries_truncated_doc_tail():
+    """A doc crossing the row boundary resumes in the next row — the tail
+    pairs are trained, not dropped (only the final row's overhang is lost)."""
+    from orion_tpu.data.loader import pack_rows
+
+    long = np.arange(100, 116)                      # 16 tokens, 15 pairs
+    b = pack_rows([[long], []], seq_len=10)
+    # Row 0: first 10 pairs of the doc.
+    np.testing.assert_array_equal(b["inputs"][0], long[:10])
+    np.testing.assert_array_equal(b["targets"][0], long[1:11])
+    # Row 1: the carried tail resumes at token 10 — pair (110 -> 111) first,
+    # so no pair is dropped or duplicated across the split.
+    np.testing.assert_array_equal(b["inputs"][1][:5], long[10:15])
+    np.testing.assert_array_equal(b["targets"][1][:5], long[11:16])
+    assert b["loss_mask"][1].sum() == 5
+    # The tail is its own segment with restarted positions.
+    np.testing.assert_array_equal(b["segment_ids"][1][:5], [1] * 5)
+    np.testing.assert_array_equal(b["positions"][1][:5], np.arange(5))
+
+
+def test_pack_rows_masks_empty_rows():
+    """A row with no packable document (all spans < 2 tokens) trains
+    nothing: fully masked, segment 0 everywhere."""
+    from orion_tpu.data.loader import pack_rows
+
+    b = pack_rows([[np.array([7])], [np.array([1, 2, 3])]], seq_len=4)
+    assert b["loss_mask"][0].sum() == 0
+    assert (b["segment_ids"][0] == 0).all()
+    assert b["loss_mask"][1].sum() == 2
+
+
 def test_synthetic_packed_loader():
     from orion_tpu.config import DataConfig
     from orion_tpu.data import make_loader

@@ -175,6 +175,39 @@ def test_oversized_prompt_rejected_at_submit():
         eng.submit([1] * 40, 4)
 
 
+def test_bad_sampling_overrides_rejected_at_submit():
+    """Out-of-range per-request sampling params raise at submit() instead of
+    silently clamping / degenerating mid-decode."""
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit([1, 2, 3], 2, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit([1, 2, 3], 2, top_k=cfg.model.vocab_size + 1)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit([1, 2, 3], 2, top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit([1, 2, 3], 2, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit([1, 2, 3], 2, top_p=1.5)
+    # In-range values still queue.
+    eng.submit([1, 2, 3], 2, temperature=0.7, top_k=0, top_p=1.0)
+
+
+def test_default_valued_overrides_stay_on_fast_program():
+    """Explicitly passing the engine-default sampling values is normalized to
+    'no override': the batch must keep the specialized greedy decode program
+    (no sort-based sampling switch)."""
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    icfg = cfg.inference
+    rid = eng.submit([1, 2, 3], 2, temperature=icfg.temperature,
+                     top_k=icfg.top_k, top_p=icfg.top_p)
+    req = eng.waiting[-1]
+    assert req.rid == rid
+    assert req.temperature is None and req.top_k is None and req.top_p is None
+
+
 def test_preemption_under_pool_pressure():
     """When concurrent decodes exhaust the page pool, the youngest request
     is preempted, re-prefilled from its context later, and still produces
