@@ -53,8 +53,9 @@ class ModelConfig:
     # Sliding-window attention (Mistral-family): attend only to the last N
     # positions. Supported in training (xla + flash kernel, with block
     # skipping) and serving (prefill + both decode paths; the paged kernel
-    # skips pages behind the window, making decode O(window)). Unsupported
-    # under sequence parallelism (the ring/Ulysses paths raise).
+    # skips pages behind the window, making decode O(window)). Composes
+    # with sequence parallelism: every SP method threads the window, and
+    # the plain ring truncates its scan to O(window) communication.
     sliding_window: Optional[int] = None
 
     # Mixture-of-experts (0 experts => dense MLP).
@@ -63,6 +64,12 @@ class ModelConfig:
     # Token capacity per expert = capacity_factor * tokens / n_experts.
     capacity_factor: float = 1.25
     router_aux_loss_weight: float = 0.01
+    # Dispatch implementation (models/moe.py): "einsum" (one-hot
+    # contractions, sharding fully SPMD-automatic), "sorted" (ragged
+    # scatter/gather dispatch — no one-hot matmul FLOPs, composes like
+    # einsum), "sorted_a2a" (sorted + explicit shard_map all_to_all on ep;
+    # per-slice overflow drops; not composable with pp).
+    moe_dispatch: str = "sorted"
 
     # Numerics.
     dtype: str = "bfloat16"         # activation / weight compute dtype

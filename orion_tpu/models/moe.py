@@ -1,22 +1,41 @@
 """Mixture-of-experts layer with capacity-based top-k dispatch.
 
 Covers the reference's Mixtral 8x7B workload (BASELINE.json:10, "expert-
-parallel all-to-all"). TPU-native design: dispatch/combine are einsums against
-a static-capacity one-hot tensor, so everything is static-shaped for XLA, and
-expert parallelism is purely a sharding choice — the expert axis of the
-weights is sharded on the ``ep`` mesh axis and XLA inserts the all-to-all
-(ICI) at the dispatch/combine boundaries. Overflowing tokens beyond capacity
-are dropped (Switch-style), which keeps the hot path dense.
+parallel all-to-all"). Everything is static-shaped for XLA; overflowing
+tokens beyond capacity are dropped (Switch-style). Three dispatch modes
+(``model.moe_dispatch``), identical semantics where their drop rules
+coincide (see each docstring):
+
+  - **einsum** — dispatch/combine are einsums against a static-capacity
+    one-hot tensor; expert parallelism is purely a sharding choice (expert
+    weight axis on ``ep``; XLA inserts the all-to-all at the dispatch/
+    combine boundaries). Simple and robust, but the one-hot contractions
+    cost ~2*S*(E*C)*D extra matmul FLOPs per layer (~12 % of expert FLOPs
+    at Mixtral shapes) and materialize a [B,S,E,C] float tensor.
+  - **sorted** — the ragged dispatch: integer routing (cumsum positions),
+    tokens scattered into [E, C] capacity buckets by index, batched
+    expert matmuls on the bucketed activations, combine by gather. The
+    TPU-static equivalent of "argsort tokens by expert + segment-sliced
+    expert matmuls": no one-hot contractions, no [B,S,E,C] tensor —
+    dispatch cost drops from matmul FLOPs to pure memory movement.
+    Sharding stays SPMD-automatic, so it composes like einsum.
+  - **sorted_a2a** — the sorted dispatch inside an explicit ``shard_map``
+    over ``ep`` with ``lax.all_to_all`` moving capacity buckets to the
+    expert owners (the literal NCCL-a2a structure of the reference,
+    BASELINE.json:10). Tokens are routed per ep-local sequence slice, so
+    overflow drops are per-slice rather than global-priority.
 
 Aux load-balancing loss follows Switch/Mixtral: E * sum_e f_e * p_e.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from orion_tpu.config import ModelConfig
 
@@ -27,6 +46,41 @@ def moe_capacity(cfg: ModelConfig, tokens_per_group: int) -> int:
     return max(cap, 1)
 
 
+def _router_topk(
+    x: jax.Array, router_w: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared router head: (probs [B,S,E] f32, gate [B,S,k] f32 renormalized,
+    idx [B,S,k] int32)."""
+    logits = jnp.einsum(
+        "bsd,de->bse", x, router_w, preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = jax.lax.top_k(probs, cfg.n_experts_per_token)
+    gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)  # renormalize
+    return probs, gate, idx
+
+
+def _aux_stats(
+    probs: jax.Array, idx: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Per-expert (assignment fraction [E], mean router prob [E]) — the two
+    token-mean statistics of the Switch load-balance loss. Token means
+    compose across equal-sized shards by plain averaging, so sharded
+    callers pmean these BEFORE taking the product (the loss is bilinear in
+    the stats, not linear in per-shard losses)."""
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B,S,k,E]
+    frac = onehot.sum(axis=2).mean(axis=(0, 1)) / k
+    mean_prob = probs.mean(axis=(0, 1))
+    return frac, mean_prob
+
+
+def _aux_loss(probs: jax.Array, idx: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Switch eq. 4 load-balance loss: E * sum_e fraction_e * mean-prob_e."""
+    frac, mean_prob = _aux_stats(probs, idx, cfg)
+    return cfg.n_experts * jnp.sum(frac * mean_prob)
+
+
 def route(
     x: jax.Array, router_w: jax.Array, cfg: ModelConfig
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -35,13 +89,7 @@ def route(
     E, k = cfg.n_experts, cfg.n_experts_per_token
     C = moe_capacity(cfg, S)
 
-    logits = jnp.einsum(
-        "bsd,de->bse", x, router_w, preferred_element_type=jnp.float32
-    )
-    probs = jax.nn.softmax(logits, axis=-1)  # [B,S,E] f32
-
-    gate, idx = jax.lax.top_k(probs, k)  # [B,S,k]
-    gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)  # renormalize
+    probs, gate, idx = _router_topk(x, router_w, cfg)
 
     # Slot-major priority: all slot-0 (top-1) choices claim capacity before
     # any slot-1 choice, matching Switch-Transformer semantics.
@@ -58,13 +106,7 @@ def route(
         disp_flat.reshape(B, k, S, E, C) * gate_slot
     ).sum(axis=1)  # [B,S,E,C]
 
-    # Load-balance aux loss (Switch eq. 4): E * sum_e fraction_e * prob_e.
-    frac = onehot[:, :, 0, :].mean(axis=(0, 1)) if k == 1 else (
-        onehot.sum(axis=2).mean(axis=(0, 1)) / k
-    )
-    mean_prob = probs.mean(axis=(0, 1))
-    aux = E * jnp.sum(frac * mean_prob)
-    return disp, comb, aux
+    return disp, comb, _aux_loss(probs, idx, cfg)
 
 
 def moe_mlp(
@@ -81,14 +123,215 @@ def moe_mlp(
     disp = disp.astype(dtype)
     comb = comb.astype(dtype)
 
-    # Dispatch: [B,S,E,C] x [B,S,D] -> [E, B*C? ] keep (E,B,C,D) grouping.
+    # Dispatch: [B,S,E,C] x [B,S,D] -> (E,B,C,D) capacity buckets.
     xin = jnp.einsum("bsec,bsd->ebcd", disp, x)
+    out = _expert_ffn(xin, params, cfg)
+    y = jnp.einsum("bsec,ebcd->bsd", comb, out)
+    return y, aux.astype(jnp.float32)
+
+
+def route_indices(
+    x: jax.Array, router_w: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Integer routing for the sorted dispatch.
+
+    Returns (idx [B,S,k] int32 expert per assignment, gate [B,S,k] f32,
+    pos [B,S,k] int32 position within the expert's capacity, keep [B,S,k]
+    bool, aux_stats — see _aux_stats; callers combine shard stats before
+    forming the loss). Drop semantics are IDENTICAL to ``route``: slot-major
+    priority (every top-1 claim beats any top-2 claim), first-come within a
+    slot, capacity C per expert per batch row — the int32 cumsum here and
+    route()'s float one-hot cumsum count the same stream.
+    """
+    B, S, _ = x.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+    C = moe_capacity(cfg, S)
+
+    probs, gate, idx = _router_topk(x, router_w, cfg)
+
+    # Slot-major assignment stream [B, k*S]: all slot-0 choices precede any
+    # slot-1 choice (matches route()'s prio layout).
+    idx_km = idx.transpose(0, 2, 1).reshape(B, k * S)
+    onehot = jax.nn.one_hot(idx_km, E, dtype=jnp.int32)      # [B, kS, E]
+    pos_all = jnp.cumsum(onehot, axis=1) - onehot            # count before me
+    pos_km = jnp.take_along_axis(
+        pos_all, idx_km[..., None], axis=-1
+    )[..., 0]                                                # [B, kS]
+    pos = pos_km.reshape(B, k, S).transpose(0, 2, 1)         # [B, S, k]
+    keep = pos < C
+    return idx, gate, pos, keep, _aux_stats(probs, idx, cfg)
+
+
+def _expert_ffn(xin: jax.Array, params: dict[str, Any], cfg: ModelConfig
+                ) -> jax.Array:
+    """Batched expert feed-forward on capacity buckets. xin: [E, B, C, D]."""
     h_in = jnp.einsum("ebcd,edf->ebcf", xin, params["w_in"])
     if cfg.activation == "swiglu":
         h_gate = jnp.einsum("ebcd,edf->ebcf", xin, params["w_gate"])
         h = jax.nn.silu(h_gate) * h_in
     else:
         h = jax.nn.gelu(h_in)
-    out = jnp.einsum("ebcf,efd->ebcd", h, params["w_out"])
-    y = jnp.einsum("bsec,ebcd->bsd", comb, out)
+    return jnp.einsum("ebcf,efd->ebcd", h, params["w_out"])
+
+
+def _scatter_dispatch(x, idx, pos, keep, E, C):
+    """Tokens -> capacity buckets by index. x: [B,S,D] -> [E, B, C, D].
+
+    Dropped assignments land in a trash row (C) that is sliced off; kept
+    (expert, pos) pairs are unique per batch row, so the scatter-add never
+    actually collides and its gradient is the plain gather transpose.
+    """
+    B, S, D = x.shape
+    k = idx.shape[-1]
+    b_ix = jnp.broadcast_to(jnp.arange(B)[:, None, None], (B, S, k))
+    pos_c = jnp.where(keep, pos, C)
+    xin = jnp.zeros((B, E, C + 1, D), x.dtype)
+    xv = jnp.broadcast_to(x[:, :, None, :], (B, S, k, D))
+    xin = xin.at[b_ix, idx, pos_c].add(xv, mode="drop")
+    return xin[:, :, :C].transpose(1, 0, 2, 3)               # [E, B, C, D]
+
+
+def _gather_combine(out, idx, pos, keep, gate, dtype):
+    """Inverse of _scatter_dispatch: per-assignment gather + gate-weighted
+    sum over the k slots. out: [E, B, C, D] -> [B, S, D]."""
+    B = out.shape[1]
+    S, k = idx.shape[1], idx.shape[2]
+    out_b = out.transpose(1, 0, 2, 3)                        # [B, E, C, D]
+    b_ix = jnp.broadcast_to(jnp.arange(B)[:, None, None], (B, S, k))
+    pos_cl = jnp.minimum(pos, out.shape[2] - 1)
+    got = out_b[b_ix, idx, pos_cl]                           # [B, S, k, D]
+    w = (gate * keep.astype(gate.dtype)).astype(dtype)
+    return jnp.einsum("bskd,bsk->bsd", got.astype(dtype), w)
+
+
+def moe_mlp_sorted(
+    x: jax.Array, params: dict[str, Any], cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """The ragged (sort-class) dispatch: einsum-free, same drop semantics as
+    ``moe_mlp``. Sharding is SPMD-automatic (expert axis of the weights and
+    the [E, ...] buckets shard on ``ep``), so it composes with every other
+    axis exactly like the einsum path."""
+    dtype = x.dtype
+    E, C = cfg.n_experts, moe_capacity(cfg, x.shape[1])
+    idx, gate, pos, keep, (frac, mp) = route_indices(
+        x, params["router"], cfg)
+    xin = _scatter_dispatch(x, idx, pos, keep, E, C)
+    out = _expert_ffn(xin, params, cfg)
+    y = _gather_combine(out, idx, pos, keep, gate, dtype)
+    aux = E * jnp.sum(frac * mp)
     return y, aux.astype(jnp.float32)
+
+
+def moe_mlp_sorted_a2a(
+    x: jax.Array,
+    params: dict[str, Any],
+    cfg: ModelConfig,
+    mesh,
+    *,
+    batch_axes: tuple = ("dp", "fsdp"),
+) -> tuple[jax.Array, jax.Array]:
+    """Sorted dispatch with an EXPLICIT expert all-to-all over the ``ep``
+    mesh axis (the reference's NCCL-a2a structure, BASELINE.json:10).
+
+    Inside a ``shard_map``, each device routes its own sequence slice
+    (S/ep tokens) into per-expert capacity buckets, one tiled
+    ``lax.all_to_all`` hands every bucket to its expert's owner, the owner
+    runs the batched expert FFN over its ep*C_loc-deep buckets, and the
+    inverse all-to-all returns outputs for local combine. Capacity is per
+    slice (C_loc = capacity(S/ep)), so total per-expert capacity matches
+    the einsum path but overflow drops are per-slice rather than global
+    slot-major — identical results whenever nothing overflows.
+
+    Composes with dp/fsdp (batch axes pass through) and tp (weights'
+    F axis); NOT with pp (the pipeline already owns a shard_map).
+    """
+    sp_ax = cfg.sequence_axis or "sp"
+    ep = mesh.shape.get("ep", 1)
+    if ep == 1:
+        return moe_mlp_sorted(x, params, cfg)
+    if mesh.shape.get("pp", 1) > 1:
+        raise ValueError(
+            "moe_dispatch='sorted_a2a' does not compose with pipeline "
+            "parallelism (nested shard_map); use 'sorted'"
+        )
+    E = cfg.n_experts
+    if E % ep:
+        raise ValueError(f"n_experts {E} not divisible by ep={ep}")
+    if x.shape[1] % (mesh.shape.get(sp_ax, 1) * ep):
+        raise ValueError(
+            f"seq len {x.shape[1]} not divisible by sp*ep for the a2a "
+            f"token slicing"
+        )
+
+    has_gate = "w_gate" in params
+
+    def body(x_loc, router_w, w_in, w_out, *gate_w):
+        p_loc = {"w_in": w_in, "w_out": w_out}
+        if has_gate:
+            p_loc["w_gate"] = gate_w[0]
+        C_loc = moe_capacity(cfg, x_loc.shape[1])
+        idx, gate, pos, keep, (frac, mp) = route_indices(
+            x_loc, router_w, cfg)
+        xin = _scatter_dispatch(x_loc, idx, pos, keep, E, C_loc)
+        # [E, B_loc, C_loc, D] -> [E/ep, B_loc, ep*C_loc, D]: bucket j of
+        # expert e travels to e's owner; owners see every slice's bucket.
+        xin = lax.all_to_all(
+            xin, "ep", split_axis=0, concat_axis=2, tiled=True)
+        out = _expert_ffn(xin, p_loc, cfg)
+        # The F axis of the expert weights is tp-sharded, so the w_out
+        # contraction leaves each tp shard holding a partial sum: reduce
+        # over tp BEFORE the inverse a2a (megatron row-parallel pattern).
+        if mesh.shape.get("tp", 1) > 1:
+            out = lax.psum(out, "tp")
+        out = lax.all_to_all(
+            out, "ep", split_axis=2, concat_axis=0, tiled=True)
+        y = _gather_combine(out, idx, pos, keep, gate, x_loc.dtype)
+        # Combine the aux STATS across equal-sized token/batch shards, then
+        # form the bilinear loss — this reproduces the global-token aux
+        # exactly (a pmean of per-shard losses would not: the loss is a
+        # product of two token means). tp shards carry identical values.
+        axes = ("dp", "fsdp", "ep", sp_ax, "tp")
+        frac = lax.pmean(frac, axis_name=axes)
+        mp = lax.pmean(mp, axis_name=axes)
+        aux = E * jnp.sum(frac * mp)
+        return y, aux
+
+    x_spec = P(batch_axes, (sp_ax, "ep"), None)
+    in_specs = [
+        x_spec,
+        P(None, None),                 # router replicated
+        P("ep", None, "tp"),           # w_in  [E, D, F]
+        P("ep", "tp", None),           # w_out [E, F, D]
+    ]
+    args = [x, params["router"], params["w_in"], params["w_out"]]
+    if has_gate:
+        in_specs.append(P("ep", None, "tp"))   # w_gate [E, D, F]
+        args.append(params["w_gate"])
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )
+    y, aux = mapped(*args)
+    return y, aux.astype(jnp.float32)
+
+
+def moe_dispatch(
+    x: jax.Array,
+    params: dict[str, Any],
+    cfg: ModelConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Entry point: select the dispatch per ``cfg.moe_dispatch``."""
+    mode = cfg.moe_dispatch
+    if mode == "einsum":
+        return moe_mlp(x, params, cfg)
+    if mode == "sorted":
+        return moe_mlp_sorted(x, params, cfg)
+    if mode == "sorted_a2a":
+        if mesh is None or mesh.shape.get("ep", 1) == 1:
+            return moe_mlp_sorted(x, params, cfg)
+        return moe_mlp_sorted_a2a(x, params, cfg, mesh)
+    raise ValueError(f"unknown model.moe_dispatch={mode!r}")

@@ -246,7 +246,7 @@ def out_proj(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
 
 
 def mlp_or_moe(
-    h: jax.Array, bp: Params, cfg: ModelConfig
+    h: jax.Array, bp: Params, cfg: ModelConfig, mesh: Optional[Any] = None
 ) -> tuple[jax.Array, jax.Array]:
     """The post-attention half of a block: dense MLP or MoE. Returns (y, aux)."""
     if cfg.is_moe:
@@ -254,7 +254,7 @@ def mlp_or_moe(
             k: v.astype(h.dtype) if k != "router" else v
             for k, v in bp["moe"].items()
         }
-        return moe_lib.moe_mlp(h, moe_params, cfg)
+        return moe_lib.moe_dispatch(h, moe_params, cfg, mesh)
     return _mlp_block(h, bp["mlp"], cfg), jnp.zeros((), jnp.float32)
 
 
@@ -351,7 +351,7 @@ def _block(
                             positions, segment_ids, mesh)
     with jax.named_scope("mlp_moe"):
         h = _norm(x, bp["mlp_norm"], cfg)
-        y, aux = mlp_or_moe(h, bp, cfg)
+        y, aux = mlp_or_moe(h, bp, cfg, mesh)
     return x + y, aux
 
 

@@ -211,6 +211,70 @@ def test_attention_segment_masking():
     )
 
 
+def _moe_setup(seed=0, B=2, S=32, D=16, overflow=False):
+    import dataclasses
+
+    from orion_tpu.models import moe as moe_lib
+
+    cfg = get_config("tiny-mixtral").model
+    if overflow:
+        # Capacity well under demand so the drop path is exercised.
+        cfg = dataclasses.replace(cfg, capacity_factor=0.5)
+    keys = jax.random.split(jax.random.key(seed), 5)
+    E, F = cfg.n_experts, cfg.d_ff
+    x = jax.random.normal(keys[0], (B, S, D), jnp.float32)
+    params = {
+        "router": jax.random.normal(keys[1], (D, E), jnp.float32) * 0.3,
+        "w_in": jax.random.normal(keys[2], (E, D, F), jnp.float32) * 0.1,
+        "w_gate": jax.random.normal(keys[3], (E, D, F), jnp.float32) * 0.1,
+        "w_out": jax.random.normal(keys[4], (E, F, D), jnp.float32) * 0.1,
+    }
+    return moe_lib, cfg, x, params
+
+
+@pytest.mark.parametrize("overflow", [False, True])
+def test_moe_sorted_matches_einsum(overflow):
+    """The ragged scatter/gather dispatch implements the einsum path's exact
+    drop semantics (slot-major priority, first-come within slot, capacity
+    per batch row) — outputs and aux loss must agree, including under
+    capacity overflow."""
+    moe_lib, cfg, x, params = _moe_setup(overflow=overflow)
+    y_e, aux_e = moe_lib.moe_mlp(x, params, cfg)
+    y_s, aux_s = moe_lib.moe_mlp_sorted(x, params, cfg)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e), atol=2e-5)
+    np.testing.assert_allclose(float(aux_s), float(aux_e), rtol=1e-6)
+
+
+@pytest.mark.parametrize("overflow", [False, True])
+def test_moe_sorted_grads_match_einsum(overflow):
+    moe_lib, cfg, x, params = _moe_setup(seed=3, overflow=overflow)
+
+    def loss(fn, x, params):
+        y, aux = fn(x, params, cfg)
+        return (y ** 2).sum() + aux
+
+    g_e = jax.grad(lambda x, p: loss(moe_lib.moe_mlp, x, p),
+                   argnums=(0, 1))(x, params)
+    g_s = jax.grad(lambda x, p: loss(moe_lib.moe_mlp_sorted, x, p),
+                   argnums=(0, 1))(x, params)
+    np.testing.assert_allclose(np.asarray(g_s[0]), np.asarray(g_e[0]),
+                               atol=5e-5)
+    for k in g_e[1]:
+        np.testing.assert_allclose(
+            np.asarray(g_s[1][k]), np.asarray(g_e[1][k]), atol=5e-5,
+            err_msg=k,
+        )
+
+
+def test_moe_dispatch_unknown_mode_raises():
+    import dataclasses
+
+    moe_lib, cfg, x, params = _moe_setup()
+    bad = dataclasses.replace(cfg, moe_dispatch="banana")
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        moe_lib.moe_dispatch(x, params, bad)
+
+
 def test_moe_aux_loss_balanced_router_is_one():
     """A perfectly uniform router gives aux loss ~= 1 (Switch normalization)."""
     from orion_tpu.models import moe as moe_lib

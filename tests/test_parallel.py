@@ -52,6 +52,93 @@ def test_moe_ep_matches_single_device():
         np.testing.assert_allclose(l.loss, b.loss, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.parametrize("dispatch", ["einsum", "sorted", "sorted_a2a"])
+def test_moe_dispatch_modes_match_under_ep(dispatch):
+    """All three MoE dispatch implementations train to the same losses on an
+    ep=4 x dp=2 mesh. Run at generous capacity (no overflow) so
+    sorted_a2a's per-slice drop rule coincides with global priority."""
+    base = _run("tiny-mixtral", 3, "model.capacity_factor=8.0")
+    got = _run(
+        "tiny-mixtral", 3, "model.capacity_factor=8.0",
+        f"model.moe_dispatch={dispatch}", "parallel.ep=4", "parallel.dp=2",
+    )
+    for b, l in zip(base, got):
+        np.testing.assert_allclose(l.loss, b.loss, rtol=5e-3, atol=5e-3)
+
+
+def test_moe_sorted_a2a_composes_with_tp():
+    """ep x tp: the tp-sharded F contraction must psum before the inverse
+    all_to_all (regression: each tp shard used to return a 1/tp partial)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from orion_tpu.models import moe as moe_lib
+    from tests.conftest import make_mesh
+
+    cfg = get_config("tiny-mixtral", ["runtime.platform=cpu"]).model
+    cfg = dataclasses.replace(cfg, capacity_factor=8.0)
+    mesh = make_mesh(jax.devices("cpu")[:8], dp=2, ep=2, tp=2)
+    keys = jax.random.split(jax.random.key(5), 5)
+    E, D, F = cfg.n_experts, 16, cfg.d_ff
+    x = jax.random.normal(keys[0], (4, 32, D), jnp.float32)
+    params = {
+        "router": jax.random.normal(keys[1], (D, E)) * 0.3,
+        "w_in": jax.random.normal(keys[2], (E, D, F)) * 0.1,
+        "w_gate": jax.random.normal(keys[3], (E, D, F)) * 0.1,
+        "w_out": jax.random.normal(keys[4], (E, F, D)) * 0.1,
+    }
+    with jax.default_device(jax.devices("cpu")[0]):
+        y_ref, _ = moe_lib.moe_mlp(x, params, cfg)
+        y_a2a, _ = jax.jit(
+            lambda x, p: moe_lib.moe_mlp_sorted_a2a(x, p, cfg, mesh)
+        )(x, params)
+    np.testing.assert_allclose(np.asarray(y_a2a), np.asarray(y_ref),
+                               atol=2e-5)
+
+
+def test_moe_sorted_a2a_uses_explicit_all_to_all():
+    """The sorted_a2a path must lower a REAL all_to_all on the ep axis (the
+    reference's NCCL-a2a structure), not rely on SPMD-inferred comm."""
+    import jax.numpy as jnp
+
+    from orion_tpu.models import moe as moe_lib
+    from tests.conftest import make_mesh
+
+    cfg = get_config(
+        "tiny-mixtral", ["runtime.platform=cpu", "model.moe_dispatch=sorted_a2a"]
+    ).model
+    mesh = make_mesh(jax.devices("cpu")[:8], dp=2, ep=4)
+    keys = jax.random.split(jax.random.key(0), 5)
+    E, D, F = cfg.n_experts, 16, cfg.d_ff
+    x = jax.random.normal(keys[0], (4, 32, D), jnp.float32)
+    params = {
+        "router": jax.random.normal(keys[1], (D, E)) * 0.3,
+        "w_in": jax.random.normal(keys[2], (E, D, F)) * 0.1,
+        "w_gate": jax.random.normal(keys[3], (E, D, F)) * 0.1,
+        "w_out": jax.random.normal(keys[4], (E, F, D)) * 0.1,
+    }
+    with jax.default_device(jax.devices("cpu")[0]):
+        hlo = jax.jit(
+            lambda x, p: moe_lib.moe_mlp_sorted_a2a(x, p, cfg, mesh)
+        ).lower(x, params).as_text()
+        assert "all_to_all" in hlo or "all-to-all" in hlo
+        # And it matches the einsum reference (no overflow at these shapes?
+        # capacity may drop; compare against sorted on the same slicing
+        # instead: run a2a and the plain sorted path on identical inputs at
+        # generous capacity).
+        import dataclasses
+
+        cfg_big = dataclasses.replace(cfg, capacity_factor=8.0)
+        y_ref, aux_ref = moe_lib.moe_mlp(x, params, cfg_big)
+        y_a2a, aux_a2a = jax.jit(
+            lambda x, p: moe_lib.moe_mlp_sorted_a2a(x, p, cfg_big, mesh)
+        )(x, params)
+    np.testing.assert_allclose(np.asarray(y_a2a), np.asarray(y_ref),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_a2a), float(aux_ref), rtol=1e-5)
+
+
 def test_quantized_grad_reduce_tracks_exact(single_device_baseline):
     """DP with int8-wire gradient all-reduce (train.grad_quant_bits=8;
     comm/quantized.py) must track the exact-reduction loss trajectory to
