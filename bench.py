@@ -71,14 +71,15 @@ def bench_train(overrides) -> int:
     return 0
 
 
-def bench_infer(overrides) -> int:
+def bench_infer(overrides, metric="llama_flagship_decode_tput") -> int:
     """Continuous-batching decode throughput (BASELINE config 5).
 
     DECODE_BATCH concurrent streams on the flagship bench model; measures
     steady-state engine steps (scheduler + fused decode+sample program +
     the per-step [B] token fetch) and reports tokens/sec/chip plus MBU
     against the HBM roofline (decode is bandwidth-bound: every step reads
-    all params + the active KV pages).
+    all params + the active KV pages). Called a second time with
+    inference.kv_quant=int8 for the quantized-KV serving line.
     """
     import jax
     import numpy as np
@@ -111,12 +112,14 @@ def bench_infer(overrides) -> int:
 
     for _ in range(DECODE_WARMUP):   # includes prefill + decode compiles
         eng.step()
+    eng.reset_timing()
     n0 = total_generated()
     t0 = time.perf_counter()
     for _ in range(DECODE_TIMED):
         eng.step()
     dt = time.perf_counter() - t0
     n_tokens = total_generated() - n0
+    timing = eng.reset_timing()
 
     dev = jax.devices()[0]
     tok_per_sec = n_tokens / dt
@@ -128,10 +131,11 @@ def bench_infer(overrides) -> int:
     )
     m = cfg.model
     mean_ctx = PROMPT_LEN + (n0 + n_tokens // 2) // DECODE_BATCH
-    kv_bytes = (
-        DECODE_BATCH * mean_ctx * m.n_layers * m.n_kv_heads
-        * m.resolved_head_dim * 2 * 2
-    )
+    kv_itemsize = eng.cache["k"].dtype.itemsize   # 2 (bf16) or 1 (int8)
+    per_tok = m.n_kv_heads * m.resolved_head_dim * kv_itemsize
+    if "k_scale" in eng.cache:
+        per_tok += m.n_kv_heads * 4               # f32 scale per (tok, head)
+    kv_bytes = DECODE_BATCH * mean_ctx * m.n_layers * per_tok * 2  # K and V
     hbm = HBM_BYTES_PER_SEC.get(dev.device_kind)
     mbu = (
         (param_bytes + kv_bytes) * device_steps_per_sec / hbm
@@ -139,7 +143,7 @@ def bench_infer(overrides) -> int:
     )
 
     result = {
-        "metric": "llama_flagship_decode_tput",
+        "metric": metric,
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
         # No published serving baseline exists (BASELINE.json: {}); mbu is
@@ -151,6 +155,16 @@ def bench_infer(overrides) -> int:
         "decode_batch": DECODE_BATCH,
         "decode_window": cfg.inference.decode_window,
         "steps_per_sec": round(device_steps_per_sec, 2),
+        # Per-window wall split (engine.step timing): how much of each
+        # engine step is the fused decode program + token fetch vs the
+        # host scheduler — the data that tunes inference.decode_window.
+        "device_ms_per_window": round(
+            timing["device_s"] / max(timing["windows"], 1) * 1e3, 2),
+        "host_ms_per_window": round(
+            timing["host_s"] / max(timing["windows"], 1) * 1e3, 2),
+        "host_share": round(
+            timing["host_s"] / max(timing["host_s"] + timing["device_s"],
+                                   1e-9), 4),
         "device": dev.device_kind,
         "model": cfg.model.name,
     }
@@ -204,6 +218,16 @@ def main() -> int:
         rc |= bench_infer(sys.argv[1:])
     except Exception as e:  # the training line is the judged primary
         print(json.dumps({"metric": "llama_flagship_decode_tput",
+                          "error": repr(e)}))
+    try:
+        # Quantized-KV serving line: halves per-token KV traffic on the
+        # HBM-bound decode roofline (inference.kv_quant, PERF.md).
+        rc |= bench_infer(
+            ["inference.kv_quant=int8"] + sys.argv[1:],
+            metric="llama_flagship_decode_tput_kvint8",
+        )
+    except Exception as e:
+        print(json.dumps({"metric": "llama_flagship_decode_tput_kvint8",
                           "error": repr(e)}))
     return rc
 

@@ -248,6 +248,13 @@ class DataConfig:
     # (pipeline microbatching cannot carry per-row segment state).
     packed: bool = False
     eos_token_id: int = 0            # document separator for packed memmap
+    # Row-crossing document tails carry into the next row only within
+    # fixed groups of this many GLOBAL rows (overhang at a group boundary
+    # is dropped, like a final row). A fixed group keeps the packed stream
+    # process-count invariant (elastic resume) while letting each host
+    # read/pack only group-aligned row ranges instead of the whole global
+    # batch.
+    pack_carry_group: int = 8
     # Held-out eval stream (train.eval_interval): a separate memmap token
     # file, or — for synthetic/same-file setups — the train source under a
     # different shuffle seed (disjoint windows with high probability).
@@ -315,6 +322,12 @@ class InferenceConfig:
     # window). Larger windows amortize host round-trips — tens of ms on a
     # tunneled chip — at the cost of decoding past EOS by up to W-1 tokens.
     decode_window: int = 8
+    # KV-cache quantization: None (pool in model dtype) or "int8" (pool in
+    # int8 with per-token per-kv-head f32 scales stored alongside;
+    # dequantization happens inside the paged kernel / at the xla gather).
+    # Decode is HBM-bound on params + KV traffic, so halving KV bytes buys
+    # throughput directly at long contexts (see PERF.md serving notes).
+    kv_quant: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -538,6 +551,26 @@ def _p_mistral7b() -> Config:
         parallel=ParallelConfig(fsdp=8),
         data=DataConfig(batch_size=32, seq_len=8192),
         optimizer=OptimizerConfig(learning_rate=3e-4),
+    )
+
+
+@register_preset("llama3-8b-256k-ring")
+def _p_llama8b_256k() -> Config:
+    """Long-context flagship (SURVEY.md §6 "Long-context"): Llama-3 8B at a
+    262,144-token context via striped-ring sequence parallelism on an
+    sp-heavy v5p-64 mesh (fsdp=4 x sp=16). The striped (zigzag-class)
+    layout needs S % sp^2 == 0: 262144 = 2^18, sp^2 = 256. Every batch row
+    is one whole 256k document; activations stay sequence-sharded through
+    the whole block stack (norms/MLP are pointwise over sequence), and the
+    flash kernel's dynamic block-skip keeps the causal 2x saving inside
+    each ring step."""
+    return Config(
+        model=_llama3_8b_model(max_seq_len=262_144, kernels="pallas"),
+        parallel=ParallelConfig(
+            fsdp=4, sp=16, sequence_method="ring_striped"
+        ),
+        data=DataConfig(batch_size=4, seq_len=262_144),
+        optimizer=OptimizerConfig(learning_rate=1.5e-4),
     )
 
 

@@ -3,7 +3,12 @@
 ``batch_at(step)`` returns this host's shard of the global batch as numpy
 arrays; the trainer assembles a global device array via
 ``jax.make_array_from_process_local_data``. Every loader is deterministic in
-(seed, step, process), so checkpoint/resume needs no iterator state.
+(seed, step) GLOBALLY — the host shard is a row slice of the same global
+batch, never a per-process stream — so checkpoint/resume needs no iterator
+state AND the data stream is invariant across process counts (elastic
+resume on fewer/more hosts continues the identical trajectory,
+SURVEY.md §6 "Failure detection / elastic recovery"). Each host pays the
+small cost of materializing the full global batch before slicing.
 
 The memmap path reads flat token files (uint16/uint32); a native C++ reader
 with readahead lives in orion_tpu/data/native (used when available and
@@ -43,7 +48,9 @@ class Loader(abc.ABC):
 
 
 def pack_rows(
-    docs_per_row: list[list[np.ndarray]], seq_len: int
+    docs_per_row: list[list[np.ndarray]],
+    seq_len: int,
+    carry_group: Optional[int] = None,
 ) -> Batch:
     """Pack variable-length documents into fixed [B, S] packed batches.
 
@@ -61,8 +68,12 @@ def pack_rows(
     A document that crosses a row boundary is split, not truncated: the
     untrained remainder (from the last consumed input token onward, so no
     pair is dropped or duplicated) carries over to the front of the next
-    row. Only the final row's overhang is dropped — O(1) tokens per batch
-    instead of O(docs).
+    row. ``carry_group`` bounds how far: the carry resets at every
+    multiple-of-``carry_group`` row (dropping that overhang like a final
+    row). Loaders use a FIXED group so the packed stream is a pure
+    function of (seed, step) — independent of process count — while each
+    host only has to materialize group-aligned row ranges, not the whole
+    global batch. None = carry across all rows.
     """
     B = len(docs_per_row)
     inputs = np.zeros((B, seq_len), np.int32)
@@ -72,6 +83,8 @@ def pack_rows(
     mask = np.zeros((B, seq_len), np.float32)
     carry: list[np.ndarray] = []  # docs (or tails) displaced into the next row
     for b, docs in enumerate(docs_per_row):
+        if carry_group is not None and b % carry_group == 0:
+            carry = []            # fixed reset boundary (see docstring)
         at, seg = 0, 0
         queue, carry = carry + list(docs), []
         for doc in queue:
@@ -121,27 +134,33 @@ class SyntheticLoader(Loader):
         noise = rng.integers(0, 2, size=length)
         return ((start + 3 * ramp + noise) % self.vocab_size).astype(np.int32)
 
+    def _slice(self, batch: Batch) -> Batch:
+        lo = self.process_index * self.host_batch
+        return {k: v[lo : lo + self.host_batch] for k, v in batch.items()}
+
     def batch_at(self, step: int) -> Batch:
-        b, s = self.host_batch, self.cfg.seq_len
-        rng = np.random.default_rng(
-            (self.cfg.shuffle_seed, step, self.process_index)
-        )
+        # Generate the GLOBAL batch (seeded by step only), then slice this
+        # host's rows — the stream is process-count invariant by design.
+        gb, s = self.cfg.batch_size, self.cfg.seq_len
+        rng = np.random.default_rng((self.cfg.shuffle_seed, step))
         if self.cfg.packed:
             rows = []
-            for _ in range(b):
+            for _ in range(gb):
                 docs, filled = [], 0
                 while filled < s:
                     length = int(rng.integers(8, max(9, s // 2)))
                     docs.append(self._doc(rng, length + 1))
                     filled += length
                 rows.append(docs)
-            return pack_rows(rows, s)
-        start = rng.integers(0, self.vocab_size, size=(b, 1))
+            return self._slice(
+                pack_rows(rows, s, carry_group=self.cfg.pack_carry_group)
+            )
+        start = rng.integers(0, self.vocab_size, size=(gb, 1))
         ramp = np.arange(s + 1, dtype=np.int64)[None, :]
-        noise = rng.integers(0, 2, size=(b, s + 1))
+        noise = rng.integers(0, 2, size=(gb, s + 1))
         seq = (start + 3 * ramp + noise) % self.vocab_size
         seq = seq.astype(np.int32)
-        return {"inputs": seq[:, :-1], "targets": seq[:, 1:]}
+        return self._slice({"inputs": seq[:, :-1], "targets": seq[:, 1:]})
 
 
 class MemmapLoader(Loader):
@@ -167,18 +186,32 @@ class MemmapLoader(Loader):
         self.n_windows = self.n_tokens - need + 1
 
     def _offsets_at(self, step: int) -> np.ndarray:
-        rng = np.random.default_rng(
-            (self.cfg.shuffle_seed, step, self.process_index)
-        )
-        return rng.integers(0, self.n_windows, size=self.host_batch)
+        # Global offsets (seeded by step only): every host draws the same
+        # window set and slices its rows — process-count invariant.
+        rng = np.random.default_rng((self.cfg.shuffle_seed, step))
+        return rng.integers(0, self.n_windows, size=self.cfg.batch_size)
 
     def batch_at(self, step: int) -> Batch:
         s = self.cfg.seq_len
-        rows = self.reader.gather(self._offsets_at(step), s + 1)
+        lo = self.process_index * self.host_batch
+        hi = lo + self.host_batch
+        sl = slice(lo, hi)
+        if self.cfg.packed:
+            # Carry crosses rows only within fixed global groups
+            # (pack_carry_group), so this host needs exactly the
+            # group-ALIGNED row range covering its slice — bounded extra
+            # reads (< one group), never the whole global batch.
+            G = self.cfg.pack_carry_group
+            g0 = (lo // G) * G
+            g1 = min(-(-hi // G) * G, self.cfg.batch_size)
+            fetch = slice(g0, g1)
+        else:
+            fetch = sl
+        rows = self.reader.gather(self._offsets_at(step)[fetch], s + 1)
         if hasattr(self.reader, "prefetch"):
             # Deterministic stream: page in the next step's windows while
             # this step trains (native reader issues MADV_WILLNEED).
-            self.reader.prefetch(self._offsets_at(step + 1), s + 1)
+            self.reader.prefetch(self._offsets_at(step + 1)[fetch], s + 1)
         rows = rows.astype(np.int32)
         if self.cfg.packed:
             eos = self.cfg.eos_token_id
@@ -195,7 +228,10 @@ class MemmapLoader(Loader):
                 # empty doc list: pack_rows leaves the row fully masked
                 # rather than training attention/loss across EOS boundaries.
                 docs_per_row.append(docs)
-            return pack_rows(docs_per_row, s)
+            # g0 is a group multiple, so reset boundaries computed relative
+            # to the fetched range coincide with the global ones.
+            packed = pack_rows(docs_per_row, s, carry_group=G)
+            return {k: v[lo - g0 : hi - g0] for k, v in packed.items()}
         return {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
 
 

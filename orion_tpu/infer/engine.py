@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -109,6 +110,10 @@ class InferenceEngine:
         self._admit_seq = itertools.count()
         self._key = jax.random.key(seed)
         self.preemptions = 0
+        self._dev_span = 0.0
+        self.timing = {
+            "device_s": 0.0, "host_s": 0.0, "windows": 0, "steps": 0,
+        }
 
         # Per-slot sampling params (inference.* defaults; submit() can
         # override per request, vLLM-style).
@@ -232,11 +237,35 @@ class InferenceEngine:
     def step(self) -> list[Request]:
         """Admit + prefill new requests, then run one decode WINDOW
         (inference.decode_window fused token steps, one host round-trip)
-        for all active slots; returns the requests that finished."""
+        for all active slots; returns the requests that finished.
+
+        Each step's wall time is split into ``timing`` (see reset_timing):
+        the device span (decode dispatch through the [W, B] token fetch)
+        vs everything else (admission, prefill, page bookkeeping, the
+        token loop) — the observability needed to tune
+        ``inference.decode_window`` from data rather than assertion.
+        """
+        t0 = time.perf_counter()
+        self._dev_span = 0.0
         self._admit()
-        self._decode_all()
+        decoded = self._decode_all()
+        total = time.perf_counter() - t0
+        self.timing["device_s"] += self._dev_span
+        self.timing["host_s"] += total - self._dev_span
+        self.timing["steps"] += 1
+        if decoded:
+            self.timing["windows"] += 1
         done, self._just_finished = self._just_finished, []
         return done
+
+    def reset_timing(self) -> dict:
+        """Return and zero the accumulated step timing split: device_s
+        (decode dispatch -> token fetch), host_s (scheduler remainder),
+        windows (steps that ran a decode window), steps (all steps)."""
+        out, self.timing = self.timing, {
+            "device_s": 0.0, "host_s": 0.0, "windows": 0, "steps": 0,
+        }
+        return out
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(
@@ -441,17 +470,34 @@ class InferenceEngine:
             self.seq_lens[slot] = len(context)
             admitted.append((req, s_pad))
 
-        # Pass 2 (device): ONE prefill dispatch per bucket length, the whole
-        # admission burst batched (VERDICT r2 item 4). Rows are padded up to
-        # a power-of-two batch so jit specializations stay bounded.
-        by_bucket: dict[int, list[Request]] = {}
-        for req, s_pad in admitted:
-            by_bucket.setdefault(s_pad, []).append(req)
-        for s_pad, reqs in by_bucket.items():
-            self._prefill_bucket(reqs, s_pad)
+        # Pass 2 (device). On the pallas path: ONE ragged prefill dispatch
+        # for the WHOLE burst, regardless of length mix (VERDICT r3 item
+        # 7) — rows pad to the burst's largest bucket, but the flash
+        # kernel SKIPS blocks whose rows/columns are all padding (segment
+        # id 0), so each row's attention pays ~its own length (the
+        # quadratic term; the linear ops still run at the shared width).
+        # On the xla path no block skip exists — a short row would pay the
+        # burst-max O(S^2) attention — so keep one dispatch per bucket
+        # there. Rows are padded up to a power-of-two batch so jit
+        # specializations stay bounded.
+        if admitted:
+            from orion_tpu.ops._dispatch import resolve_impl
+
+            if resolve_impl(self.mcfg.kernels)[0]:
+                self._prefill_bucket(
+                    [r for r, _ in admitted], max(s for _, s in admitted)
+                )
+            else:
+                by_bucket: dict[int, list[Request]] = {}
+                for req, s_pad in admitted:
+                    by_bucket.setdefault(s_pad, []).append(req)
+                for s_pad, reqs in by_bucket.items():
+                    self._prefill_bucket(reqs, s_pad)
 
     def _prefill_bucket(self, reqs: list[Request], s_pad: int) -> None:
-        """Prefill a group of same-bucket admitted requests in one dispatch."""
+        """Prefill a group of admitted requests in one dispatch; rows may
+        be shorter than ``s_pad`` (their tail positions write to the
+        scratch page and their compute blocks skip via segment ids)."""
         n_pages = s_pad // self.psz
         nb = 1 << (len(reqs) - 1).bit_length()   # next power of two
         tokens = np.zeros((nb, s_pad), np.int32)
@@ -463,7 +509,11 @@ class InferenceEngine:
             lengths[i] = len(context)
             # Dead (behind-window) logical pages write to scratch page 0;
             # those positions are never read back (sliding-window mask).
-            pages[i] = [0 if p is None else p for p in req.pages]
+            # Positions past this row's own bucket (shorter than the
+            # burst's) go to scratch too.
+            pages[i, : len(req.pages)] = [
+                0 if p is None else p for p in req.pages
+            ]
         logits, self.cache = self._prefill(
             self.params,
             self.cache,
@@ -530,13 +580,13 @@ class InferenceEngine:
                 self.page_table[req.slot, len(req.pages)] = page
                 req.pages.append(page)
 
-    def _decode_all(self) -> None:
+    def _decode_all(self) -> bool:
         self._roll_window()
         self._grow_pages()
         active = [r for r in self.slots if r is not None and not r.done]
         if not active:
             self._reap()
-            return
+            return False
         W = self.icfg.decode_window
         mask = np.array(
             [r is not None and not r.done for r in self.slots], bool
@@ -551,6 +601,7 @@ class InferenceEngine:
             jnp.asarray(mask),
             jax.random.split(sub, W),
         )
+        t_dev = time.perf_counter()
         if all(
             r.temperature is None and r.top_k is None and r.top_p is None
             for r in active
@@ -564,6 +615,7 @@ class InferenceEngine:
                 jnp.asarray(self.slot_top_p),
             )
         tokens = np.asarray(jax.device_get(toks))   # [W, B], ONE fetch
+        self._dev_span += time.perf_counter() - t_dev
         for j in range(W):
             for req in active:
                 if req.done:
@@ -574,6 +626,7 @@ class InferenceEngine:
                 req.generated.append(tok)
                 self._maybe_finish(req, tok)
         self._reap()
+        return True
 
     def _sample(
         self, logits: jax.Array, reqs: Optional[list[Request]] = None

@@ -48,21 +48,54 @@ def pages_per_seq(icfg: InferenceConfig) -> int:
     return icfg.max_seq_len // icfg.page_size
 
 
+SCALE_LANES = 128  # scale pools pad the token dim to a full lane tile so
+#                    their (1, K, SCALE_LANES) kernel blocks are (8, 128)-
+#                    tiling-legal f32; columns >= page_size are dead.
+
+
+def scale_width(psz: int) -> int:
+    if psz > SCALE_LANES:
+        raise ValueError(
+            f"kv_quant='int8' requires page_size <= {SCALE_LANES}, "
+            f"got {psz} (one lane tile holds one page's scales)"
+        )
+    return SCALE_LANES
+
+
+# Single definition shared with the paged kernel's fused in-kernel write
+# (decode and prefill quantization must agree bit-for-bit).
+from orion_tpu.ops.pallas.common import quantize_kv  # noqa: F401,E402
+
+
 def init_cache(
     mcfg: ModelConfig,
     icfg: InferenceConfig,
     device: Optional[jax.Device] = None,
 ) -> Cache:
-    """Allocate the paged KV pool (zeros)."""
-    shape = (
-        mcfg.n_layers * icfg.num_pages,
-        mcfg.n_kv_heads,
-        icfg.page_size,
-        mcfg.resolved_head_dim,
-    )
-    dtype = jnp.dtype(mcfg.dtype)
+    """Allocate the paged KV pool (zeros).
+
+    With ``inference.kv_quant='int8'`` the pools are int8 and carry f32
+    scale pools ``k_scale``/``v_scale`` of shape [rows, K, SCALE_LANES]
+    (column t = token t's scale on that page; lanes-padded past
+    page_size). Presence of the scale keys is what runner/kernel code
+    keys off — the cache dict is the single source of truth.
+    """
+    rows = mcfg.n_layers * icfg.num_pages
+    K, psz, H = mcfg.n_kv_heads, icfg.page_size, mcfg.resolved_head_dim
+    shape = (rows, K, psz, H)
 
     def alloc():
+        if icfg.kv_quant == "int8":
+            sw = scale_width(psz)
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros((rows, K, sw), jnp.float32),
+                "v_scale": jnp.zeros((rows, K, sw), jnp.float32),
+            }
+        if icfg.kv_quant is not None:
+            raise ValueError(f"unknown inference.kv_quant={icfg.kv_quant!r}")
+        dtype = jnp.dtype(mcfg.dtype)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     if device is not None:

@@ -94,16 +94,23 @@ def prefill_step(
     psz = cache["k"].shape[2]
     NP = cache["k"].shape[0] // cfg.n_layers
     n_pages = S_pad // psz
+    quant = "k_scale" in cache
     positions = jnp.broadcast_to(
         jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
     )
+    # Ragged burst: rows shorter than the bucket mark their padding tail
+    # with segment id 0 — the flash kernel SKIPS all-padding blocks, so a
+    # mixed-length admission burst pays per-row actual-length compute in
+    # one dispatch instead of bucket-padded compute per bucket.
+    seg = (positions < lengths[:, None]).astype(jnp.int32)
 
     def body(carry, bp, l):
-        x, kp, vp = carry
+        x, cc = carry
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         out = attention(
             q, k, v, causal=True,
+            q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
             window=cfg.sliding_window,
             block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
@@ -118,17 +125,27 @@ def prefill_step(
         # and the next real token overwrites its slot.
         K, H = k.shape[2], k.shape[3]
         rows = l * NP + pages                    # [Nb, n_pages]
+        cc = dict(cc)
+        if quant:
+            from orion_tpu.infer.kv_cache import quantize_kv
+
+            # Per (token, head) int8 + f32 scale; scale pages land in the
+            # first psz columns of the lanes-padded scale pool rows.
+            k, ks = quantize_kv(k)               # [Nb,S,K,H] i8, [Nb,S,K]
+            v, vs = quantize_kv(v)
+            kspg = ks.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
+            vspg = vs.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
+            cc["k_scale"] = cc["k_scale"].at[rows, :, :psz].set(kspg)
+            cc["v_scale"] = cc["v_scale"].at[rows, :, :psz].set(vspg)
         # Pool pages are [K, psz, H] (heads major, see kv_cache.py).
         kpages = k.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
         vpages = v.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
-        kp = kp.at[rows].set(kpages)
-        vp = vp.at[rows].set(vpages)
-        return x, kp, vp
+        cc["k"] = cc["k"].at[rows].set(kpages)
+        cc["v"] = cc["v"].at[rows].set(vpages)
+        return x, cc
 
     x = embed(params, tokens, positions, cfg)
-    x, kp, vp = _scan_layers(
-        params, cfg, body, (x, cache["k"], cache["v"])
-    )
+    x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
     # Only each row's last real position is needed; gather before the LM
     # head so the vocab matmul is [Nb, 1, V], not [Nb, S_pad, V].
     idx = (lengths - 1).astype(jnp.int32)[:, None, None]
@@ -136,23 +153,24 @@ def prefill_step(
         x, jnp.broadcast_to(idx, (Nb, 1, x.shape[-1])), axis=1
     )
     logits = unembed(params, x_last, cfg)     # [Nb, 1, V]
-    return logits[:, 0], {"k": kp, "v": vp}
+    return logits[:, 0], cache
 
 
 def _decode_core(
     params: Params,
-    kp: jax.Array,
-    vp: jax.Array,
+    cache: Cache,
     tokens: jax.Array,        # [B] newest token per slot
     write_pos: jax.Array,     # [B] int32 position being written/attended
     page_table: jax.Array,    # [B, pages_per_seq] int32 (per-layer-relative)
     cfg: ModelConfig,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One decode forward for every slot -> (logits [B, V], kp, vp)."""
+) -> tuple[jax.Array, Cache]:
+    """One decode forward for every slot -> (logits [B, V], cache')."""
     B = tokens.shape[0]
+    kp = cache["k"]
     psz = kp.shape[2]
     NP = kp.shape[0] // cfg.n_layers
     P = page_table.shape[1]
+    quant = "k_scale" in cache
     positions = write_pos[:, None]
     batch_idx = jnp.arange(B)
 
@@ -172,7 +190,8 @@ def _decode_core(
     use_pallas, interpret = resolve_impl(cfg.kernels)
 
     def body(carry, bp, l):
-        x, kp, vp = carry
+        x, cc = carry
+        cc = dict(cc)
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         K, H = k.shape[2], k.shape[3]
@@ -181,37 +200,65 @@ def _decode_core(
             # (compute proportional to actual context lengths) and writes
             # the new token's K/V itself — the pool stays in place through
             # the kernel's input/output aliasing, where an external scatter
-            # feeding the kernel would cost a pool copy per layer.
+            # feeding the kernel would cost a pool copy per layer. Under
+            # kv_quant the kernel also dequantizes in place and quantizes
+            # the written token (scales aliased alongside).
             from orion_tpu.ops.pallas.paged_attention import paged_attention
 
-            out, kp, vp = paged_attention(
-                q[:, 0], kp, vp, page_table, write_pos,
+            res = paged_attention(
+                q[:, 0], cc["k"], cc["v"], page_table, write_pos,
                 layer_base=l * NP,
                 k_new=k[:, 0], v_new=v[:, 0],
                 logit_softcap=cfg.attn_logit_softcap,
                 window=cfg.sliding_window,
                 interpret=interpret,
+                k_scale=cc.get("k_scale"),
+                v_scale=cc.get("v_scale"),
             )
+            if quant:
+                out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
+            else:
+                out, cc["k"], cc["v"] = res
             out = out[:, None]
         else:
             rows = l * NP + page_idx
-            kp = kp.at[rows, :, offset].set(k[:, 0])
-            vp = vp.at[rows, :, offset].set(v[:, 0])
+            if quant:
+                from orion_tpu.infer.kv_cache import quantize_kv
+
+                kq, ks = quantize_kv(k[:, 0])    # [B,K,H] i8, [B,K]
+                vq, vs = quantize_kv(v[:, 0])
+                cc["k"] = cc["k"].at[rows, :, offset].set(kq)
+                cc["v"] = cc["v"].at[rows, :, offset].set(vq)
+                cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
+                cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
+            else:
+                cc["k"] = cc["k"].at[rows, :, offset].set(k[:, 0])
+                cc["v"] = cc["v"].at[rows, :, offset].set(v[:, 0])
             # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather.
-            k_ctx = kp[l * NP + page_table].transpose(0, 1, 3, 2, 4)
-            v_ctx = vp[l * NP + page_table].transpose(0, 1, 3, 2, 4)
+            k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+            v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+            if quant:
+                # Dequantize the gathered context: [B, P, psz, K] scales.
+                ksc = cc["k_scale"][l * NP + page_table][..., :psz]
+                vsc = cc["v_scale"][l * NP + page_table][..., :psz]
+                k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
+                    0, 1, 3, 2)[..., None]
+                v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
+                    0, 1, 3, 2)[..., None]
+                k_ctx = k_ctx.astype(q.dtype)
+                v_ctx = v_ctx.astype(q.dtype)
             k_ctx = k_ctx.reshape(B, P * psz, K, H)
             v_ctx = v_ctx.reshape(B, P * psz, K, H)
             out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
         x = x + out_proj(out, bp["attn"], cfg)
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)
-        return x + y, kp, vp
+        return x + y, cc
 
     x = embed(params, tokens[:, None], positions, cfg)
-    x, kp, vp = _scan_layers(params, cfg, body, (x, kp, vp))
+    x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
     logits = unembed(params, x, cfg)          # [B, 1, V]
-    return logits[:, 0], kp, vp
+    return logits[:, 0], cache
 
 
 def decode_window(
@@ -241,20 +288,18 @@ def decode_window(
     from orion_tpu.infer.sampling import sample
 
     def stepf(carry, sub):
-        tok, sl, kp, vp = carry
+        tok, sl, cc = carry
         act = active & (sl < max_seq_len)
         wp = jnp.minimum(sl, max_seq_len - 1)
-        logits, kp, vp = _decode_core(
-            params, kp, vp, tok, wp, page_table, cfg
-        )
+        logits, cc = _decode_core(params, cc, tok, wp, page_table, cfg)
         toks = sample(
             logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
         )
         tok = jnp.where(act, toks, tok)
         sl = sl + act.astype(sl.dtype)
-        return (tok, sl, kp, vp), toks
+        return (tok, sl, cc), toks
 
-    (_, _, kp, vp), toks = jax.lax.scan(
-        stepf, (tokens, seq_lens, cache["k"], cache["v"]), keys
+    (_, _, cache), toks = jax.lax.scan(
+        stepf, (tokens, seq_lens, dict(cache)), keys
     )
-    return toks, {"k": kp, "v": vp}
+    return toks, cache

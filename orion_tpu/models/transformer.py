@@ -307,6 +307,10 @@ def _attn_block(
             causal=True,
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
+            # Model-level segment_ids follow the pack_rows convention
+            # (id 0 = padding; data/loader.py), so all-padding tail
+            # blocks may skip their compute in the flash kernel.
+            seg_pad_zero=True,
             logit_softcap=cfg.attn_logit_softcap,
             window=cfg.sliding_window,
             block_q=cfg.attn_block_q,

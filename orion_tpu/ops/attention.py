@@ -142,8 +142,15 @@ def attention(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     impl: str = "xla",
+    seg_pad_zero: bool = False,
 ) -> jax.Array:
-    """Grouped-query scaled-dot-product attention. Shapes as attention_xla."""
+    """Grouped-query scaled-dot-product attention. Shapes as attention_xla.
+
+    ``seg_pad_zero`` declares segment id 0 = padding so the flash kernel
+    may SKIP all-padding blocks (ragged prefill / packed tails); results
+    are unchanged for callers honoring the pack_rows convention, and the
+    xla path ignores it (no block structure to skip).
+    """
     from orion_tpu.ops._dispatch import resolve_impl
 
     use_pallas, interpret = resolve_impl(impl)
@@ -170,6 +177,7 @@ def attention(
             block_q=block_q,
             block_kv=block_kv,
             interpret=interpret,
+            seg_pad_zero=seg_pad_zero,
         )
     return attention_xla(
         q,

@@ -37,3 +37,20 @@ def pad_axis(x: jax.Array, axis: int, target: int) -> jax.Array:
     import jax.numpy as jnp
 
     return jnp.pad(x, pads)
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-vector int8: x [..., H] -> (q int8 [..., H], scale
+    [...] f32) with x ~ q * scale. Scale is per (token, kv-head).
+
+    Lives here (plain jnp, Pallas-kernel-legal) because it is the SINGLE
+    definition both the jnp cache paths (infer/kv_cache.py re-exports it)
+    and the paged kernel's fused in-kernel write must share — decode and
+    prefill quantization have to agree bit-for-bit.
+    """
+    import jax.numpy as jnp
+
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.round(x.astype(jnp.float32) / s[..., None])
+    return q.astype(jnp.int8), s

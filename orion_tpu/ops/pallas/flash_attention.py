@@ -60,6 +60,12 @@ class _Statics:
     # causal). Blocks entirely behind the window skip like causal blocks
     # entirely ahead of the diagonal.
     window: Optional[int] = None
+    # Opt-in declaration that segment id 0 means PADDING (the pack_rows /
+    # ragged-prefill convention): all-padding blocks then SKIP their
+    # compute. Off by default — the base segment semantics allow 0 as a
+    # real segment id (0==0 attends), and skipping would change results
+    # for such callers.
+    seg_pad_zero: bool = False
 
 
 def _unpack_refs(has_seg: bool, has_pos: bool, refs):
@@ -109,31 +115,46 @@ def _block_mask(st: _Statics, iq, ik, qseg_ref, kseg_ref, qpos_ref, kpos_ref):
     return mask
 
 
-def _block_run(st: _Statics, iq, ik, qpos_ref, kpos_ref):
-    """Causal block-skip condition for grid cell (iq, ik).
+def _block_run(st: _Statics, iq, ik, qpos_ref, kpos_ref,
+               qseg_ref=None, kseg_ref=None):
+    """Block-skip condition for grid cell (iq, ik).
 
-    Index mode: static-shape comparison on block indices. Position mode:
-    dynamic — a block is skippable only if its largest q position precedes
-    its smallest kv position (stripe layouts make this the common case for
-    half the blocks, preserving the 2x causal saving)."""
-    if not st.causal:
-        return True
+    Causal — index mode: static-shape comparison on block indices;
+    position mode: dynamic — a block is skippable only if its largest q
+    position precedes its smallest kv position (stripe layouts make this
+    the common case for half the blocks, preserving the 2x causal saving).
+
+    Segments — under ``st.seg_pad_zero`` (the caller declares id 0 =
+    padding, the data/loader.pack_rows / infer ragged-prefill convention):
+    a block whose q rows or kv columns are ALL padding contributes nothing
+    anywhere, so it skips. This is what makes mixed-length prefill bursts
+    and packed rows pay actual-length compute instead of bucket-padded
+    compute. Without the flag, segment blocks never skip (0 may be a real
+    segment id).
+    """
+    run = True
     bq, bk = st.block_q, st.block_kv
-    if st.has_pos:
-        q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
-        kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
-        run = jnp.max(q_ids) >= jnp.min(kv_ids)
-        if st.window is not None:
-            # Skip blocks entirely behind the window: largest kv position
-            # within reach of the smallest q position. (kv padding is
-            # PAD_POS_KV, so padded blocks stay runnable-but-masked.)
-            run &= jnp.max(kv_ids) > jnp.min(q_ids) - st.window
-        return run
-    q_max = iq * bq + bq - 1 + st.q_offset
-    run = ik * bk <= q_max
-    if st.window is not None:
-        q_min = iq * bq + st.q_offset
-        run = run & (ik * bk + bk - 1 > q_min - st.window)
+    if st.causal:
+        if st.has_pos:
+            q_ids = qpos_ref[0, 0, pl.ds(iq * bq, bq)]
+            kv_ids = kpos_ref[0, 0, pl.ds(ik * bk, bk)]
+            run = jnp.max(q_ids) >= jnp.min(kv_ids)
+            if st.window is not None:
+                # Skip blocks entirely behind the window: largest kv
+                # position within reach of the smallest q position. (kv
+                # padding is PAD_POS_KV, so padded blocks stay
+                # runnable-but-masked.)
+                run &= jnp.max(kv_ids) > jnp.min(q_ids) - st.window
+        else:
+            q_max = iq * bq + bq - 1 + st.q_offset
+            run = ik * bk <= q_max
+            if st.window is not None:
+                q_min = iq * bq + st.q_offset
+                run = run & (ik * bk + bk - 1 > q_min - st.window)
+    if st.seg_pad_zero and qseg_ref is not None:
+        q_seg = qseg_ref[0, 0, pl.ds(iq * bq, bq)]
+        kv_seg = kseg_ref[0, 0, pl.ds(ik * bk, bk)]
+        run &= (jnp.max(q_seg) > 0) & (jnp.max(kv_seg) > 0)
     return run
 
 
@@ -170,7 +191,7 @@ def _fwd_kernel(st: _Statics, has_seg, *refs):
         acc_s[:] = jnp.zeros_like(acc_s)
 
     # Skip blocks with nothing visible under the causal mask.
-    run = _block_run(st, iq, ik, qpos, kpos)
+    run = _block_run(st, iq, ik, qpos, kpos, qseg, kseg)
 
     @pl.when(run)
     def _body():
@@ -218,7 +239,7 @@ def _dq_kernel(st: _Statics, has_seg, *refs):
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    run = _block_run(st, iq, ik, qpos, kpos)
+    run = _block_run(st, iq, ik, qpos, kpos, qseg, kseg)
 
     @pl.when(run)
     def _body():
@@ -261,7 +282,7 @@ def _dkv_kernel(st: _Statics, has_seg, *refs):
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    run = _block_run(st, iq, ik, qpos, kpos)
+    run = _block_run(st, iq, ik, qpos, kpos, qseg, kseg)
 
     @pl.when(run)
     def _body():
@@ -531,7 +552,7 @@ PAD_POS_KV = 2 ** 30  # kv-position pad: larger than any real position, so
 def _prep(
     q, k, v, q_segment_ids, kv_segment_ids,
     causal, logit_softcap, q_offset, block_q, block_kv, interpret,
-    q_positions=None, kv_positions=None, window=None,
+    q_positions=None, kv_positions=None, window=None, seg_pad_zero=False,
 ):
     """Shared wrapper prep: statics + [B,N,S,H] transpose + block padding.
 
@@ -564,6 +585,7 @@ def _prep(
         interpret=resolve_interpret(interpret),
         has_pos=q_positions is not None,
         window=window,
+        seg_pad_zero=seg_pad_zero and q_segment_ids is not None,
     )
 
     qt = pad_axis(q.transpose(0, 2, 1, 3), 2, Sq_p)
@@ -608,6 +630,7 @@ def flash_attention(
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    seg_pad_zero: bool = False,
 ) -> jax.Array:
     """Flash attention; shapes/semantics match ``attention_xla``.
 
@@ -616,13 +639,16 @@ def flash_attention(
     masking compares those explicit positions (permuted/striped sequence
     layouts); otherwise token index + ``q_offset``. ``window`` restricts
     attention to the last ``window`` positions (sliding-window / Mistral;
-    blocks fully behind the window skip their compute).
+    blocks fully behind the window skip their compute). ``seg_pad_zero``
+    declares segment id 0 as padding, letting all-padding blocks SKIP
+    (ragged prefill / packed tails) — only set it when the caller
+    guarantees the pack_rows convention.
     See ``_prep`` for the tile-size default rationale.
     """
     st, qt, kt, vt, qseg, kseg, qpos, kpos, Sq = _prep(
         q, k, v, q_segment_ids, kv_segment_ids,
         causal, logit_softcap, q_offset, block_q, block_kv, interpret,
-        q_positions, kv_positions, window,
+        q_positions, kv_positions, window, seg_pad_zero,
     )
     o = _flash(st, qt, kt, vt, qseg, kseg, qpos, kpos)
     return o[:, :, :Sq, :].transpose(0, 2, 1, 3)

@@ -48,7 +48,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from orion_tpu.ops.pallas.common import NEG_INF, resolve_interpret, round_up
+from orion_tpu.ops.pallas.common import (
+    NEG_INF,
+    quantize_kv,
+    resolve_interpret,
+    round_up,
+)
 
 LANES = 128
 
@@ -60,17 +65,32 @@ def _kernel(
     G8: int,
     fused_write: bool,
     window: Optional[int],
+    quant: bool,
     pt_ref,        # [B, P] scalar-prefetched page table (per-layer-relative)
     base_ref,      # [1] scalar-prefetched flat-pool row base (layer * NP)
     sl_ref,        # [B] scalar-prefetched last valid position per sequence
     *refs,
 ):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    ks_ref = vs_ref = kn_ref = vn_ref = None
+    if quant:
+        ks_ref, vs_ref = refs[i], refs[i + 1]
+        i += 2
     if fused_write:
-        (q_ref, k_ref, v_ref, kn_ref, vn_ref,
-         o_ref, ko_ref, vo_ref, m_s, l_s, acc_s) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
-        kn_ref = vn_ref = ko_ref = vo_ref = None
+        kn_ref, vn_ref = refs[i], refs[i + 1]
+        i += 2
+    o_ref = refs[i]
+    i += 1
+    ko_ref = vo_ref = kso_ref = vso_ref = None
+    if fused_write:
+        ko_ref, vo_ref = refs[i], refs[i + 1]
+        i += 2
+        if quant:
+            kso_ref, vso_ref = refs[i], refs[i + 1]
+            i += 2
+    m_s, l_s, acc_s = refs[i:]
 
     b, ip = pl.program_id(0), pl.program_id(1)
     npages = pl.num_programs(1)
@@ -93,16 +113,33 @@ def _kernel(
         # grid step would be clobbered by the tail's final write-back.
         ko_ref[...] = k_ref[...]
         vo_ref[...] = v_ref[...]
+        if quant:
+            kso_ref[...] = ks_ref[...]
+            vso_ref[...] = vs_ref[...]
 
         @pl.when(ip >= last_pos // psz)
         def _write():
             off = last_pos % psz
-            ko_ref[0, :, pl.ds(off, 1), :] = kn_ref[0][:, None, :]
-            vo_ref[0, :, pl.ds(off, 1), :] = vn_ref[0][:, None, :]
+            if not quant:
+                ko_ref[0, :, pl.ds(off, 1), :] = kn_ref[0][:, None, :]
+                vo_ref[0, :, pl.ds(off, 1), :] = vn_ref[0][:, None, :]
+                return
+            # Quantize the new token's K/V in-kernel via the SAME function
+            # the jnp prefill path uses (common.quantize_kv) — decode and
+            # prefill quantization agree bit-for-bit by construction.
+            for new_ref, out_ref, s_ref in (
+                (kn_ref, ko_ref, kso_ref), (vn_ref, vo_ref, vso_ref),
+            ):
+                qv, s = quantize_kv(new_ref[0])             # [K, H], [K]
+                out_ref[0, :, pl.ds(off, 1), :] = qv.astype(
+                    out_ref.dtype)[:, None, :]
+                s_ref[0, :, pl.ds(off, 1)] = s[:, None]
 
         k_src, v_src = ko_ref, vo_ref
+        ks_src, vs_src = kso_ref, vso_ref
     else:
         k_src, v_src = k_ref, v_ref
+        ks_src, vs_src = ks_ref, vs_ref
 
     # Ragged skip: pages wholly beyond this sequence's context do nothing
     # (their fetches were elided by the clamped index map). With a sliding
@@ -120,7 +157,13 @@ def _kernel(
         z = lax.dot_general(
             q * scale, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ).reshape(K * G8, psz)
+        )                                                # [K, G8, psz]
+        if quant:
+            # int8 pool: the per-(head, token) K scale applies to the logit
+            # COLUMNS after the matmul (cheaper than dequantizing the
+            # [K, psz, H] block before it).
+            z = z * ks_src[0][:, :psz][:, None, :]
+        z = z.reshape(K * G8, psz)
         if softcap is not None:
             z = softcap * jnp.tanh(z / softcap)
         kv_pos = ip * psz + lax.broadcasted_iota(
@@ -139,8 +182,13 @@ def _kernel(
         l_s[:] = jnp.broadcast_to(
             l_s[:, :1] * alpha + p.sum(axis=-1, keepdims=True), l_s.shape
         )
+        pw = p.reshape(K, G8, psz)
+        if quant:
+            # Fold the V scale into the probabilities (per kv column), so
+            # the PV matmul consumes the int8 block directly.
+            pw = pw * vs_src[0][:, :psz][:, None, :]
         pv = lax.dot_general(
-            p.reshape(K, G8, psz), v, (((2,), (1,)), ((0,), (0,))),
+            pw, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                                # [K, G8, H]
         acc_s[:] = acc_s[:] * alpha + pv.reshape(K * G8, H)
@@ -154,13 +202,14 @@ def _kernel(
 
 
 def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
-          softcap, window, interpret):
+          softcap, window, interpret, k_scale=None, v_scale=None):
     B, N, H = q.shape
     rows_total, K, psz, _ = k_pool.shape
     P = page_table.shape[1]
     G = N // K
     G8 = max(round_up(G, 8), 8)
     fused_write = k_new is not None
+    quant = k_scale is not None
 
     qg = q.reshape(B, K, G, H)
     if G8 != G:
@@ -189,6 +238,16 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
     kv_spec = pl.BlockSpec((1, K, psz, H), kv_index)
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [qg, k_pool, v_pool]
+    if quant:
+        # One page's scales: (1, K, SCALE_LANES) f32 — a full (8, 128)
+        # lane tile, same clamped page walk as the data blocks.
+        sw = k_scale.shape[-1]
+        sc_spec = pl.BlockSpec(
+            (1, K, sw), lambda b, ip, pt, bs, sl: kv_index(
+                b, ip, pt, bs, sl)[:3]
+        )
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale, v_scale]
     out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B, K * G8, H), q.dtype)]
     aliases = {}
@@ -202,8 +261,19 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
             jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
         ]
         # Operand indices count the scalar-prefetch args (pt, base, sl) and
-        # q before the pools (operands 4 and 5) -> outputs 1 and 2.
-        aliases = {4: 1, 5: 2}
+        # q before the pools; without quant the pools are operands 4 and 5
+        # -> outputs 1 and 2. With quant the scale pools sit between the
+        # data pools and k_new/v_new, and are themselves aliased outputs.
+        if quant:
+            sw = k_scale.shape[-1]
+            out_specs += [sc_spec, sc_spec]
+            out_shape += [
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ]
+            aliases = {4: 1, 5: 2, 6: 3, 7: 4}
+        else:
+            aliases = {4: 1, 5: 2}
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -217,7 +287,9 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, softcap, psz, K, G8, fused_write, window),
+        functools.partial(
+            _kernel, softcap, psz, K, G8, fused_write, window, quant
+        ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
@@ -225,6 +297,8 @@ def _call(q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
     )(page_table.astype(jnp.int32), base, last_pos.astype(jnp.int32), *args)
     attn = out[0].reshape(B, K, G8, H)[:, :, :G, :].reshape(B, N, H)
     if fused_write:
+        if quant:
+            return attn, out[1], out[2], out[3], out[4]
         return attn, out[1], out[2]
     return attn, k_pool, v_pool
 
@@ -243,6 +317,8 @@ def paged_attention(
     window: Optional[int] = None,           # sliding window: attend iff
     #                                         last_pos - kv_pos < window
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,    # [rows, K, SCALE_LANES] f32:
+    v_scale: Optional[jax.Array] = None,    #   int8-pool per-token scales
 ):
     """Decode attention over the paged KV pool.
 
@@ -257,17 +333,27 @@ def paged_attention(
     and running masked attention (positions <= last_pos attend).
     ``layer_base`` may be traced (it rides the scalar-prefetch channel), so
     the call sits inside a layer scan over one carried flat pool.
+
+    With ``k_scale``/``v_scale`` the pools are int8 (inference.kv_quant):
+    the kernel dequantizes in place — K scales multiply the logit columns
+    after the QK matmul, V scales fold into the probabilities before PV —
+    and the fused write quantizes the new token in-kernel
+    (kv_cache.quantize_kv semantics), returning
+    ``(out, k_pool', v_pool', k_scale', v_scale')``.
     """
     assert (k_new is None) == (v_new is None)
+    assert (k_scale is None) == (v_scale is None)
     if window is not None and window < 1:
         raise ValueError(f"window={window} must be >= 1")
     K = k_pool.shape[1]
     assert q.shape[1] % K == 0, (q.shape, K)
     base = jnp.asarray(layer_base, jnp.int32).reshape(1)
-    attn, kp, vp = _call(
+    out = _call(
         q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
-        logit_softcap, window, interpret,
+        logit_softcap, window, interpret, k_scale, v_scale,
     )
     if k_new is None:
-        return attn
-    return attn, kp, vp
+        return out[0]
+    if k_scale is None:
+        return out[:3]
+    return out

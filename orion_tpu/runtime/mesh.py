@@ -90,13 +90,10 @@ def build_mesh(
     shape = tuple(sizes[a] for a in MESH_AXES)
 
     if parallel.dcn_axes:
-        from jax.experimental import mesh_utils
-
         ici_shape, dcn_shape = hybrid_shapes(parallel)
-        arr = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devs
+        return Mesh(
+            _hybrid_device_array(ici_shape, dcn_shape, devs), MESH_AXES
         )
-        return Mesh(arr, MESH_AXES)
 
     if devices is None and devs and devs[0].platform == "tpu":
         from jax.experimental import mesh_utils
@@ -106,6 +103,57 @@ def build_mesh(
         # CPU fake devices / explicit device list: plain row-major reshape.
         arr = np.asarray(devs).reshape(shape)
     return Mesh(arr, MESH_AXES)
+
+
+def _hybrid_device_array(
+    ici_shape: tuple[int, ...],
+    dcn_shape: tuple[int, ...],
+    devs: Sequence[jax.Device],
+) -> np.ndarray:
+    """Device array for a hybrid ICI/DCN mesh.
+
+    Real TPU multi-slice devices carry ``slice_index``: delegate to
+    ``mesh_utils.create_hybrid_device_mesh`` (topology-aware per-slice
+    arrangement). CPU multi-process runs have no slices — the process
+    boundary IS the DCN stand-in (loopback Gloo), so group devices by
+    ``process_index`` and tile the groups over the DCN axes; this is what
+    lets the dcn_axes code path run over a REAL process boundary in tests
+    instead of being stubbed. Single-process fake devices (no grouping
+    possible) fall back to a plain row-major reshape — construction-only
+    semantics, which is all a one-process mesh has anyway.
+    """
+    if devs and devs[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devs
+        )
+    n_groups = int(np.prod(dcn_shape))
+    per_group = int(np.prod(ici_shape))
+    groups: dict[int, list[jax.Device]] = {}
+    for d in devs:
+        groups.setdefault(d.process_index, []).append(d)
+    shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+    if len(groups) != n_groups or any(
+        len(g) != per_group for g in groups.values()
+    ):
+        if len(groups) == 1:
+            # Single-process fake-device testing: no real boundary exists;
+            # a deterministic reshape validates the axis bookkeeping.
+            return np.asarray(devs).reshape(shape)
+        raise ValueError(
+            f"dcn_axes wants {n_groups} process groups of {per_group} "
+            f"devices, but processes provide "
+            f"{sorted((p, len(g)) for p, g in groups.items())}"
+        )
+    out = np.empty(shape, dtype=object)
+    for gi, pid in enumerate(sorted(groups)):
+        coord = np.unravel_index(gi, dcn_shape)
+        block = np.asarray(groups[pid]).reshape(ici_shape)
+        out[tuple(
+            slice(c * i, c * i + i) for c, i in zip(coord, ici_shape)
+        )] = block
+    return out
 
 
 def local_mesh(platform: Optional[str] = None) -> Mesh:

@@ -287,6 +287,22 @@ class Trainer:
                         f"ulysses needs model.n_heads={cfg.model.n_heads} "
                         f"divisible by sp*tp={sp_tp}"
                     )
+                kv = cfg.model.n_kv_heads
+                if kv < sp_tp and sp_tp % kv == 0:
+                    # GQA KV replication (the only sub-divisible shape
+                    # sequence.py accepts): the head<->seq all_to_all moves
+                    # whole heads, so kv_heads replicate up to sp*tp — that
+                    # inflates KV comm volume by sp*tp/kv_heads vs ring's
+                    # exact O(S/sp) KV rotation. Warn and quantify so the
+                    # config author can switch (parallel.sequence_method).
+                    log.warning(
+                        "ulysses with GQA (kv_heads=%d < sp*tp=%d) "
+                        "replicates KV heads: %dx KV all_to_all volume. "
+                        "parallel.sequence_method='ring' (or "
+                        "'ring_striped') avoids the inflation for this "
+                        "config.",
+                        kv, sp_tp, sp_tp // kv,
+                    )
             cfg = _dc.replace(
                 cfg,
                 model=_dc.replace(

@@ -32,6 +32,35 @@ def test_synthetic_deterministic_and_shifted():
     np.testing.assert_array_equal(b1["inputs"][:, 1:], b1["targets"][:, :-1])
 
 
+@pytest.mark.parametrize("packed", [False, True])
+def test_loaders_invariant_across_process_counts(token_file, packed):
+    """Elastic-resume contract: the global batch at a step is identical
+    whether served by 1 process or sliced across 2 — the data stream must
+    not depend on process count (SURVEY.md §6 elastic recovery)."""
+    from orion_tpu.data.loader import MemmapLoader, SyntheticLoader
+
+    path, _ = token_file
+    cfgs = [
+        (SyntheticLoader,
+         DataConfig(batch_size=4, seq_len=32, packed=packed),
+         {"vocab_size": 256}),
+        (MemmapLoader,
+         DataConfig(source="memmap", path=path, batch_size=4, seq_len=32,
+                    packed=packed, eos_token_id=0, use_native_loader=False),
+         {"vocab_size": 256}),
+    ]
+    for cls, cfg, kw in cfgs:
+        whole = cls(cfg, 0, 1, **kw).batch_at(5)
+        lo = cls(cfg, 0, 2, **kw).batch_at(5)
+        hi = cls(cfg, 1, 2, **kw).batch_at(5)
+        for key in whole:
+            np.testing.assert_array_equal(
+                whole[key],
+                np.concatenate([lo[key], hi[key]]),
+                err_msg=f"{cls.__name__}.{key}",
+            )
+
+
 def test_native_reader_matches_numpy(token_file):
     path, tokens = token_file
     native = pytest.importorskip("orion_tpu.data.native")

@@ -147,33 +147,59 @@ def test_hybrid_shapes_rejects_typo():
         hybrid_shapes(ParallelConfig(dp=2, dcn_axes=("dpp",)))
 
 
-def test_build_mesh_hybrid_path(cpu_devices, monkeypatch):
-    """parallel.dcn_axes routes through create_hybrid_device_mesh with the
-    ici/dcn split and yields a correctly-named mesh (fake CPU devices carry
-    no slice_index, so the jax helper itself is stubbed — this validates
-    OUR axis bookkeeping, the part a typo would break)."""
-    import numpy as np
-    from jax.experimental import mesh_utils
-
+def test_build_mesh_hybrid_path(cpu_devices):
+    """parallel.dcn_axes routes through the hybrid constructor and yields a
+    correctly-named, correctly-shaped mesh on fake devices (the REAL
+    process-boundary behavior is exercised by
+    tests/test_multihost.py::test_two_process_hybrid_dcn_mesh)."""
     from orion_tpu.config import ParallelConfig
     from orion_tpu.runtime import build_mesh
 
-    seen = {}
-
-    def fake_hybrid(ici_shape, dcn_shape, devices=None, **kw):
-        seen["ici"], seen["dcn"] = tuple(ici_shape), tuple(dcn_shape)
-        shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
-        return np.asarray(devices).reshape(shape)
-
-    monkeypatch.setattr(
-        mesh_utils, "create_hybrid_device_mesh", fake_hybrid
-    )
     cfg = ParallelConfig(dp=2, fsdp=2, tp=2, dcn_axes=("dp",))
     mesh = build_mesh(cfg, devices=cpu_devices[:8])
-    assert seen["ici"] == (1, 1, 2, 1, 1, 2)
-    assert seen["dcn"] == (1, 2, 1, 1, 1, 1)
     assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1,
                                 "sp": 1, "tp": 2}
+    # A collective over the hybrid-constructed mesh computes correctly.
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    x = jnp.arange(8.0)
+    y = jax.shard_map(
+        lambda v: jax.lax.psum(v, "dp"),
+        mesh=mesh, in_specs=P(("dp", "fsdp", "tp")),
+        out_specs=P(("dp", "fsdp", "tp")), check_vma=False,
+    )(x)
+    assert float(y.sum()) == float(x.sum()) * 2  # psum over dp=2
+
+
+def test_hybrid_process_group_assembly():
+    """The process-group DCN assembly: group devices by process_index, tile
+    over the dcn axes; mismatched group structure raises clearly."""
+    import numpy as np
+
+    from orion_tpu.runtime.mesh import _hybrid_device_array
+
+    class Dev:
+        platform = "cpu"
+
+        def __init__(self, pid, i):
+            self.process_index, self.i = pid, i
+
+        def __repr__(self):
+            return f"d{self.process_index}.{self.i}"
+
+    devs = [Dev(p, i) for p in range(2) for i in range(4)]
+    ici = (1, 1, 2, 1, 1, 2)   # fsdp=2, tp=2 on "ICI"
+    dcn = (1, 2, 1, 1, 1, 1)   # dp crosses the process boundary
+    arr = _hybrid_device_array(ici, dcn, devs)
+    assert arr.shape == (1, 2, 2, 1, 1, 2)
+    # dp coordinate == process id (each process is one "slice").
+    assert all(d.process_index == 0 for d in arr[0, 0].flat)
+    assert all(d.process_index == 1 for d in arr[0, 1].flat)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="process groups"):
+        _hybrid_device_array(ici, dcn, devs[:6])  # ragged groups
 
 
 # -- quantized all-reduce (EQuARX-class; comm/quantized.py) -------------------

@@ -22,7 +22,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process(extra=()):
+def _run_workers(n, extra=()):
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -31,11 +31,11 @@ def _run_two_process(extra=()):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2", str(port), *extra],
+            [sys.executable, WORKER, str(pid), str(n), str(port), *extra],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env,
         )
-        for pid in range(2)
+        for pid in range(n)
     ]
     outs = []
     try:
@@ -62,9 +62,13 @@ def _run_two_process(extra=()):
     return losses
 
 
+def _run_two_process(extra=()):
+    return _run_workers(2, extra)
+
+
 @pytest.fixture(scope="module")
 def exact_two_process_losses():
-    """One exact-reduction run shared by both tests (each run spawns two
+    """One exact-reduction run shared by the tests (each run spawns two
     full jax.distributed bring-ups; no need to pay for it twice)."""
     return _run_two_process()
 
@@ -85,3 +89,48 @@ def test_two_process_int8_grad_reduce(exact_two_process_losses):
     np.testing.assert_allclose(quant[0], quant[1], rtol=1e-6)
     for a, b in zip(exact_two_process_losses[0], quant[0]):
         np.testing.assert_allclose(b, a, rtol=3e-2, atol=3e-2)
+
+
+def test_two_process_hybrid_dcn_mesh(exact_two_process_losses):
+    """A 2-process mesh built through the hybrid ICI/DCN constructor
+    (parallel.dcn_axes=dp, one 'slice' per process) must train the exact
+    same trajectory as the plain dp=2 mesh — the DCN-spanning layout is a
+    construction detail, never semantics (SURVEY.md §6 'Distributed
+    communication backend', VERDICT r3 weak #6)."""
+    hybrid = _run_two_process(["parallel.dcn_axes=dp"])
+    np.testing.assert_allclose(hybrid[0], hybrid[1], rtol=1e-6)
+    np.testing.assert_allclose(
+        hybrid[0], exact_two_process_losses[0], rtol=1e-5)
+
+
+def test_elastic_resume_across_process_counts(tmp_path):
+    """The torchelastic-class scenario (SURVEY.md §6 'Failure detection /
+    elastic recovery'): a checkpoint written by a 2-process dp=2 run is
+    restored by a SINGLE process (lose a host, resume on fewer) and the
+    trajectory continues exactly as an uninterrupted run — and the reverse
+    (scale back up) also holds. Process count, like layout, is restart
+    configuration, not training state.
+
+    The LR-decay horizon is pinned explicitly (optimizer.decay_steps) in
+    every phase: it defaults to train.num_steps, and an interrupted run's
+    stop step is NOT its schedule horizon."""
+    pin = ["optimizer.decay_steps=16", "train.num_steps=16"]
+    base = _run_workers(1, pin)[0]
+
+    # Scale DOWN: 2-process dp=2 checkpoint -> 1-process resume.
+    down = str(tmp_path / "down")
+    common = [f"checkpoint.directory={down}", "checkpoint.async_save=false",
+              "optimizer.decay_steps=16"]
+    _run_workers(2, common + ["train.num_steps=8"])
+    cont = _run_workers(
+        1, common + ["train.num_steps=16", "parallel.dp=1"])[0]
+    np.testing.assert_allclose(cont, base[8:], rtol=1e-3, atol=1e-3)
+
+    # Scale UP: 1-process checkpoint -> 2-process dp=2 resume.
+    up = str(tmp_path / "up")
+    common = [f"checkpoint.directory={up}", "checkpoint.async_save=false",
+              "optimizer.decay_steps=16"]
+    _run_workers(1, common + ["train.num_steps=8", "parallel.dp=1"])
+    cont2 = _run_workers(2, common + ["train.num_steps=16"])
+    np.testing.assert_allclose(cont2[0], cont2[1], rtol=1e-6)
+    np.testing.assert_allclose(cont2[0], base[8:], rtol=1e-3, atol=1e-3)

@@ -348,6 +348,113 @@ def test_paged_attention_fused_write():
     )
 
 
+def test_flash_ragged_padding_rows_parity_and_grads():
+    """Segment id 0 marks padding (ragged prefill / packed tails): the
+    all-padding block SKIP must not change results — parity vs the xla
+    reference with the same segment mask, fwd and grads, at per-row
+    ragged lengths that leave whole blocks padded."""
+    from orion_tpu.ops.attention import attention_xla
+    from orion_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, S, N, K, H = 3, 256, 4, 2, 64
+    ks = jax.random.split(jax.random.key(17), 3)
+    q = jax.random.normal(ks[0], (B, S, N, H), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, K, H), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, K, H), jnp.float32)
+    lengths = jnp.asarray([256, 70, 3])      # full, mid-block, tiny
+    seg = (jnp.arange(S)[None, :] < lengths[:, None]).astype(jnp.int32)
+
+    def loss_p(q, k, v):
+        o = flash_attention(q, k, v, causal=True, q_segment_ids=seg,
+                            kv_segment_ids=seg, seg_pad_zero=True,
+                            block_q=64, block_kv=64, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2 * seg[..., None, None])
+
+    def loss_x(q, k, v):
+        o = attention_xla(q, k, v, causal=True, q_segment_ids=seg,
+                          kv_segment_ids=seg)
+        return jnp.sum(o.astype(jnp.float32) ** 2 * seg[..., None, None])
+
+    o_p = flash_attention(q, k, v, causal=True, q_segment_ids=seg,
+                          kv_segment_ids=seg, seg_pad_zero=True,
+                          block_q=64, block_kv=64, interpret=True)
+    o_x = attention_xla(q, k, v, causal=True, q_segment_ids=seg,
+                        kv_segment_ids=seg)
+    # Compare only real rows: padding rows are garbage by contract.
+    m = np.asarray(seg, bool)
+    np.testing.assert_allclose(
+        np.asarray(o_p)[m], np.asarray(o_x)[m], atol=2e-5)
+    g_p = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_x, g_p):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
+def test_paged_attention_int8_matches_dequantized_reference():
+    """int8 pools + per-(token, head) scales: the kernel's in-place
+    dequantization (K scales on logit columns, V scales folded into the
+    probabilities) must reproduce masked attention over the explicitly
+    dequantized pools, including the fused in-kernel quantized write."""
+    from orion_tpu.infer.kv_cache import quantize_kv
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+    N, K = 8, 2
+    B, H, psz, P, num_pages = 3, 64, 16, 4, 32
+    SW = 128
+    keys = jax.random.split(jax.random.key(11), 6)
+    q = jax.random.normal(keys[0], (B, N, H), jnp.float32)
+    kf = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.float32)
+    vf = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.float32)
+    k_new = jax.random.normal(keys[3], (B, K, H), jnp.float32)
+    v_new = jax.random.normal(keys[4], (B, K, H), jnp.float32)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
+    )
+    last_pos = jnp.asarray([0, 37, 63], jnp.int32)
+
+    # Host-side quantization (the prefill path): [rows, K, psz, H] over H.
+    kq, ks = quantize_kv(kf.transpose(0, 2, 1, 3))   # scale [rows, psz, K]
+    vq, vs = quantize_kv(vf.transpose(0, 2, 1, 3))
+    kq = kq.transpose(0, 2, 1, 3)
+    vq = vq.transpose(0, 2, 1, 3)
+    k_scale = jnp.zeros((num_pages, K, SW), jnp.float32
+                        ).at[:, :, :psz].set(ks.transpose(0, 2, 1))
+    v_scale = jnp.zeros((num_pages, K, SW), jnp.float32
+                        ).at[:, :, :psz].set(vs.transpose(0, 2, 1))
+
+    # Reference: dequantize everything ([rows, K, psz] scales broadcast
+    # over H), external scatter, masked attention.
+    kd = kq.astype(jnp.float32) * k_scale[:, :, :psz][..., None]
+    vd = vq.astype(jnp.float32) * v_scale[:, :, :psz][..., None]
+    knq, kns = quantize_kv(k_new)
+    vnq, vns = quantize_kv(v_new)
+    rows = page_table[jnp.arange(B), last_pos // psz]
+    kd_ref = kd.at[rows, :, last_pos % psz].set(
+        knq.astype(jnp.float32) * kns[..., None])
+    vd_ref = vd.at[rows, :, last_pos % psz].set(
+        vnq.astype(jnp.float32) * vns[..., None])
+    ref = _paged_reference(q, kd_ref, vd_ref, page_table, last_pos)
+
+    out, kp2, vp2, ks2, vs2 = paged_attention(
+        q, kq, vq, page_table, last_pos,
+        k_new=k_new, v_new=v_new,
+        k_scale=k_scale, v_scale=v_scale, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # In-kernel quantized write matches the host-side quantization.
+    np.testing.assert_allclose(
+        np.asarray(kp2[rows, :, last_pos % psz]), np.asarray(knq), atol=0)
+    np.testing.assert_allclose(
+        np.asarray(ks2[rows, :, last_pos % psz]), np.asarray(kns),
+        rtol=1e-6)
+    # And the quantized attention is close to the float answer.
+    float_ref = _paged_reference(
+        q, kf.at[rows, :, last_pos % psz].set(k_new),
+        vf.at[rows, :, last_pos % psz].set(v_new), page_table, last_pos)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(float_ref), atol=0.06)
+
+
 def test_paged_attention_softcap():
     from orion_tpu.ops.pallas.paged_attention import paged_attention
 

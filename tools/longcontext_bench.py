@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Single-chip long-sequence attention benchmark (VERDICT r3 item 4).
+
+Measures, at bench-model dims (d_model=2048, 16 heads, GQA 8 kv) over
+S in {8k, 16k, 32k}:
+
+  - flash kernel fwd+bwd time vs the xla attention reference (the causal
+    block-skip's value grows with S — attention is O(S^2), everything
+    else O(S)),
+  - the attention share of a full transformer block fwd+bwd, i.e. the
+    fraction of step time the ring distributes at long context,
+  - the windowed-flash time at window=4096 (the O(S*W) sliding-window
+    regime the Mistral-family long-context path rides).
+
+    python tools/longcontext_bench.py          # on-chip numbers
+    python tools/longcontext_bench.py --cpu    # tiny-shape logic check
+
+Output: one JSON line per (S, measurement).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(fn, args, iters=10, warmup=2):
+    out = jax.jit(fn)
+    for _ in range(warmup):
+        jax.block_until_ready(out(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = out(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    cpu = "--cpu" in sys.argv[1:]
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    elif jax.default_backend() != "tpu":
+        print("SKIP: no TPU backend (use --cpu for the logic check)")
+        return 0
+
+    from orion_tpu.ops.attention import attention_xla
+    from orion_tpu.ops.pallas.flash_attention import flash_attention
+
+    if cpu:
+        seqs, N, K, H, D, F = [256, 512], 4, 2, 64, 256, 512
+        interpret = True
+    else:
+        # Bench-model dims (llama-1b-bench): the 16 GB v5e bounds B*S.
+        seqs, N, K, H, D, F = [8192, 16384, 32768], 16, 8, 128, 2048, 8192
+        interpret = False
+    dev = jax.devices("cpu" if cpu else None)[0]
+
+    with jax.default_device(dev):
+        for S in seqs:
+            ks = jax.random.split(jax.random.key(0), 4)
+            q = jax.random.normal(ks[0], (1, S, N, H), jnp.bfloat16)
+            k = jax.random.normal(ks[1], (1, S, K, H), jnp.bfloat16)
+            v = jax.random.normal(ks[2], (1, S, K, H), jnp.bfloat16)
+
+            def loss_flash(q, k, v, window=None):
+                o = flash_attention(q, k, v, causal=True, window=window,
+                                    interpret=interpret)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            def loss_xla(q, k, v):
+                o = attention_xla(q, k, v, causal=True)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            grad_f = jax.grad(loss_flash, argnums=(0, 1, 2))
+            t_flash = bench(grad_f, (q, k, v))
+            t_win = bench(
+                jax.grad(
+                    lambda q, k, v: loss_flash(q, k, v, window=4096
+                                               if not cpu else 128),
+                    argnums=(0, 1, 2)),
+                (q, k, v))
+            try:
+                t_xla = bench(jax.grad(loss_xla, argnums=(0, 1, 2)),
+                              (q, k, v))
+            except Exception:           # [S,S] logits OOM at long S
+                t_xla = None
+
+            # Attention share of a full block: attention + the block's
+            # matmul FLOPs (qkv/out proj + swiglu MLP) timed as real ops.
+            x = jax.random.normal(ks[3], (1, S, D), jnp.bfloat16)
+            wq = jax.random.normal(ks[0], (D, N * H), jnp.bfloat16) * 0.02
+            wkv = jax.random.normal(ks[1], (D, 2 * K * H), jnp.bfloat16) * 0.02
+            wo = jax.random.normal(ks[2], (N * H, D), jnp.bfloat16) * 0.02
+            w1 = jax.random.normal(ks[0], (D, 2 * F), jnp.bfloat16) * 0.02
+            w2 = jax.random.normal(ks[1], (F, D), jnp.bfloat16) * 0.02
+
+            def block_matmuls(x):
+                a = x @ wq
+                b = x @ wkv          # [1, S, 2*K*H]; 2*K*H == D here
+                y = a @ wo + b[..., :D]   # consume b: keep the KV-proj
+                h = x @ w1                # matmul out of DCE's reach
+                hh = jax.nn.silu(h[..., :F]) * h[..., F:]
+                return jnp.sum((y + hh @ w2).astype(jnp.float32) ** 2)
+
+            t_mm = bench(jax.grad(block_matmuls), (x,))
+            share = t_flash / (t_flash + t_mm)
+            print(json.dumps({
+                "S": S,
+                "flash_fwdbwd_ms": round(t_flash * 1e3, 2),
+                "window_fwdbwd_ms": round(t_win * 1e3, 2),
+                "xla_fwdbwd_ms": (round(t_xla * 1e3, 2)
+                                  if t_xla is not None else None),
+                "attention_share_of_block": round(share, 4),
+                "speedup_vs_xla": (round(t_xla / t_flash, 2)
+                                   if t_xla is not None else None),
+                "window_speedup": round(t_flash / t_win, 2),
+            }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

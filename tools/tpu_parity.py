@@ -130,6 +130,32 @@ def paged_checks() -> bool:
     )(q, kp2, vp2)
     ok &= check("paged layer_base fwd", out_l1,
                 reference(q, k_pool * 0.5, v_pool * 0.5), 2e-2)
+
+    # int8 KV pools (inference.kv_quant): in-kernel dequantization + the
+    # fused quantized write, vs attention over the dequantized pools.
+    from orion_tpu.infer.kv_cache import SCALE_LANES, quantize_kv
+
+    kq, ks = quantize_kv(k_pool.transpose(0, 2, 1, 3))
+    vq, vs = quantize_kv(v_pool.transpose(0, 2, 1, 3))
+    kq, vq = kq.transpose(0, 2, 1, 3), vq.transpose(0, 2, 1, 3)
+    k_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(ks.transpose(0, 2, 1))
+    v_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(vs.transpose(0, 2, 1))
+    knq, kns = quantize_kv(k_new)
+    vnq, vns = quantize_kv(v_new)
+    kd = (kq.astype(jnp.float32) * k_sc[:, :, :psz][..., None]).at[
+        rows, :, last_pos % psz].set(knq.astype(jnp.float32) * kns[..., None])
+    vd = (vq.astype(jnp.float32) * v_sc[:, :, :psz][..., None]).at[
+        rows, :, last_pos % psz].set(vnq.astype(jnp.float32) * vns[..., None])
+    out_q = jax.jit(
+        lambda q, kp, vp, ksc, vsc, kn, vn: paged_attention(
+            q, kp, vp, page_table, last_pos, k_new=kn, v_new=vn,
+            k_scale=ksc, v_scale=vsc, interpret=INTERP)[0]
+    )(q, kq, vq, k_sc, v_sc, k_new, v_new)
+    ok &= check("paged int8 fwd", out_q,
+                reference(q, kd.astype(jnp.bfloat16),
+                          vd.astype(jnp.bfloat16)), 2e-2)
     return ok
 
 
