@@ -48,6 +48,9 @@ class ModelConfig:
     activation: str = "swiglu"      # "swiglu" | "gelu"
     tie_embeddings: bool = True
     attn_bias: bool = False
+    # Output-projection bias; None follows attn_bias. Qwen2-family models
+    # carry q/k/v biases but no o bias (attn_bias=True, attn_out_bias=False).
+    attn_out_bias: Optional[bool] = None
     mlp_bias: bool = False
     attn_logit_softcap: Optional[float] = None
     # Sliding-window attention (Mistral-family): attend only to the last N
@@ -130,6 +133,13 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.n_experts > 0
+
+    @property
+    def resolved_attn_out_bias(self) -> bool:
+        return (
+            self.attn_bias
+            if self.attn_out_bias is None else self.attn_out_bias
+        )
 
     def num_params(self) -> int:
         """Approximate parameter count (embeddings + blocks + norms)."""
@@ -280,6 +290,15 @@ class TrainConfig:
     # step; grad_accum splits it into that many sequential microbatches (must
     # divide batch_size). Token throughput is unaffected; memory shrinks.
     grad_accum: int = 1
+    # Dtype gradients are computed/stacked in (None = param_dtype). With
+    # scan_layers, per-layer grads are written into stacked [L, ...]
+    # buffers via dynamic-update-slice each bwd step — the "scan stash"
+    # share of the profile (PERF.md). "bfloat16" halves those bytes (and
+    # the grad-clip/optimizer read traffic); the AdamW update still runs
+    # in f32 against the f32 master params, so only the gradient signal
+    # itself is rounded (standard mixed-precision practice). Measure per
+    # model: the trajectory tracks f32 closely but not bitwise.
+    grad_dtype: Optional[str] = None
     # Profiling window (jax.profiler trace), e.g. (10, 20). None disables.
     profile_steps: Optional[Tuple[int, int]] = None
     profile_dir: str = "/tmp/orion_tpu_profile"
@@ -571,6 +590,25 @@ def _p_llama8b_256k() -> Config:
         ),
         data=DataConfig(batch_size=4, seq_len=262_144),
         optimizer=OptimizerConfig(learning_rate=1.5e-4),
+    )
+
+
+@register_preset("qwen2-7b-fsdp")
+def _p_qwen2_7b() -> Config:
+    """Qwen2/Qwen2.5-7B: Llama-family architecture + q/k/v projection
+    biases (no o bias). Weights import via models.convert.from_hf_qwen2."""
+    return Config(
+        model=ModelConfig(
+            name="qwen2-7b", vocab_size=152_064, max_seq_len=8192,
+            d_model=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+            d_ff=18944, pos_embedding="rope", rope_theta=1_000_000.0,
+            norm="rmsnorm", norm_eps=1e-6, activation="swiglu",
+            tie_embeddings=False, attn_bias=True, attn_out_bias=False,
+            dtype="bfloat16", kernels="pallas", remat="full",
+        ),
+        parallel=ParallelConfig(fsdp=8),
+        data=DataConfig(batch_size=32, seq_len=8192),
+        optimizer=OptimizerConfig(learning_rate=3e-4),
     )
 
 

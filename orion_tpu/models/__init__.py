@@ -11,6 +11,7 @@ from orion_tpu.models.convert import (
     from_hf_gpt2,
     from_hf_llama,
     from_hf_mixtral,
+    from_hf_qwen2,
 )
 from orion_tpu.models.transformer import (
     forward,
@@ -24,6 +25,7 @@ __all__ = [
     "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",
+    "from_hf_qwen2",
     "init_params",
     "loss_fn",
     "param_logical_axes",

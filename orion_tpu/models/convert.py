@@ -191,6 +191,39 @@ def from_hf_llama(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     return _cast(cfg, params)
 
 
+def from_hf_qwen2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
+    """Qwen2/Qwen2.5-family ``Qwen2ForCausalLM`` state dict.
+
+    The Llama schema plus q/k/v projection biases (and no o bias) —
+    cfg should set ``attn_bias=True, attn_out_bias=False``.
+    """
+    if not cfg.attn_bias or cfg.resolved_attn_out_bias:
+        raise ValueError(
+            "Qwen2-family configs need attn_bias=True, attn_out_bias=False "
+            f"(got attn_bias={cfg.attn_bias}, "
+            f"attn_out_bias={cfg.resolved_attn_out_bias})"
+        )
+    params = from_hf_llama(sd, cfg)
+    blocks = params["blocks"]
+    L = cfg.n_layers
+    bq, bk, bv = [], [], []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        bq.append(np.asarray(sd[p + "self_attn.q_proj.bias"]))
+        bk.append(np.asarray(sd[p + "self_attn.k_proj.bias"]))
+        bv.append(np.asarray(sd[p + "self_attn.v_proj.bias"]))
+    if cfg.scan_layers:
+        blocks["attn"]["bq"] = np.stack(bq)
+        blocks["attn"]["bk"] = np.stack(bk)
+        blocks["attn"]["bv"] = np.stack(bv)
+    else:
+        for i, b in enumerate(blocks):
+            b["attn"]["bq"], b["attn"]["bk"], b["attn"]["bv"] = (
+                bq[i], bk[i], bv[i]
+            )
+    return _cast(cfg, params)
+
+
 def from_hf_gpt2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     """GPT-2 ``GPT2LMHeadModel`` state dict (Conv1D stores [in, out])."""
     D = cfg.d_model

@@ -50,12 +50,24 @@ def _router_topk(
     x: jax.Array, router_w: jax.Array, cfg: ModelConfig
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared router head: (probs [B,S,E] f32, gate [B,S,k] f32 renormalized,
-    idx [B,S,k] int32)."""
+    idx [B,S,k] int32).
+
+    Top-k is argsort + a one-hot product rather than ``lax.top_k`` +
+    gather: identical values/indices (verified in tests), negligible cost
+    at router width E, and — unlike top_k and gather's scatter transpose —
+    it survives checkify's index-check rewrite in this jax version, so
+    ``runtime.checkify`` keeps its FULL check set on MoE models too.
+    """
+    E = cfg.n_experts
     logits = jnp.einsum(
         "bsd,de->bse", x, router_w, preferred_element_type=jnp.float32
     )
     probs = jax.nn.softmax(logits, axis=-1)
-    gate, idx = jax.lax.top_k(probs, cfg.n_experts_per_token)
+    idx = jnp.argsort(-probs, axis=-1)[
+        ..., : cfg.n_experts_per_token
+    ].astype(jnp.int32)
+    onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)   # [B,S,k,E]
+    gate = (probs[..., None, :] * onehot).sum(-1)        # scatter-free gather
     gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)  # renormalize
     return probs, gate, idx
 

@@ -12,6 +12,7 @@ mesh axes (dp/fsdp/tp/sp/ep) — parallelism never appears in model code.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -80,6 +81,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             block["attn"]["bq"] = jnp.zeros((N * H,), pdt)
             block["attn"]["bk"] = jnp.zeros((K * H,), pdt)
             block["attn"]["bv"] = jnp.zeros((K * H,), pdt)
+        if cfg.resolved_attn_out_bias:
             block["attn"]["bo"] = jnp.zeros((D,), pdt)
         if cfg.is_moe:
             E = cfg.n_experts
@@ -136,6 +138,7 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
         block["attn"]["bq"] = lead + ("heads",)
         block["attn"]["bk"] = lead + ("kv_heads",)
         block["attn"]["bv"] = lead + ("kv_heads",)
+    if cfg.resolved_attn_out_bias:
         block["attn"]["bo"] = lead + ("embed",)
     if cfg.is_moe:
         block["moe"] = {
@@ -240,7 +243,7 @@ def out_proj(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     y = jnp.einsum(
         "bsh,hd->bsd", out.reshape(B, S, -1), _load_w(p["wo"], dtype)
     )
-    if cfg.attn_bias:
+    if cfg.resolved_attn_out_bias:
         y = y + p["bo"].astype(dtype)
     return y
 
@@ -457,6 +460,38 @@ def _hidden_states(
     return x, moe_aux
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gather_target_impl(V, logits, targets):
+    return jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+
+
+def _gather_target_fwd(V, logits, targets):
+    return _gather_target_impl(V, logits, targets), (targets,)
+
+
+def _gather_target_bwd(V, res, g):
+    (targets,) = res
+    return (g[..., None] * jax.nn.one_hot(targets, V, dtype=g.dtype), None)
+
+
+_gather_target_impl.defvjp(_gather_target_fwd, _gather_target_bwd)
+
+
+def _gather_target(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-token target logit [..., S] from logits [..., S, V].
+
+    Forward is the plain gather; the custom VJP replaces gather's scatter-
+    add transpose with a one-hot multiply. Two reasons: scatter serializes
+    badly on TPU where the select-style one-hot product vectorizes (the CE
+    backward materializes a [B, S, V] cotangent either way), and the
+    checkify index-check rewrite in this jax version crashes on the
+    scatter (trace-time IndexError) — this formulation lets
+    runtime.checkify run the FULL check set, including out-of-bounds
+    index checks, over the train step (SANITIZERS.md).
+    """
+    return _gather_target_impl(logits.shape[-1], logits, targets)
+
+
 def loss_fn(
     params: Params,
     batch: dict[str, jax.Array],
@@ -495,7 +530,7 @@ def loss_fn(
             mesh=mesh,
         )
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        nll = -_gather_target(logp, targets)
         if mask is None:
             mask = jnp.ones_like(nll)
         mask = mask.astype(jnp.float32)
@@ -527,7 +562,7 @@ def loss_fn(
         with jax.named_scope("unembed_chunk"):
             logits = unembed(params, xc, cfg)  # [B, chunk, V] float32
         logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        tgt = _gather_target(logits, tc)
         nll_sum = ((logz - tgt) * mc).sum()
         return (carry[0] + nll_sum, carry[1] + mc.sum()), None
 

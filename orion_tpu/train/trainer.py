@@ -96,20 +96,37 @@ def make_train_step(
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     mcfg = cfg.model
     accum = cfg.train.grad_accum
+    gdt = (
+        jnp.dtype(cfg.train.grad_dtype)
+        if cfg.train.grad_dtype is not None else None
+    )
+
+    def _value_and_grad(params, mb):
+        """value_and_grad of the loss; under train.grad_dtype the grads are
+        taken wrt a downcast param tree, so every stacked per-layer grad
+        buffer (the scan-stash traffic, PERF.md) carries that dtype. The
+        optimizer upcasts per leaf; with grad_accum the accumulator tree
+        stays f32 (zeros_like(params) + bf16 promotes), so only the
+        per-microbatch gradient signal is rounded."""
+        if gdt is not None:
+            params = jax.tree.map(
+                lambda p: p.astype(gdt)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+        return jax.value_and_grad(loss_fn, has_aux=True)(
+            params, mb, mcfg, mesh
+        )
 
     def loss_and_grads(params, batch):
         if accum == 1:
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, batch, mcfg, mesh)
+            (loss, aux), grads = _value_and_grad(params, batch)
             return loss, aux, grads
 
         # batch leaves are [A, b, S]; scan over microbatches, summing grads.
         def micro(carry, mb):
             acc_grads, acc_loss, acc_aux = carry
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, mb, mcfg, mesh)
+            (loss, aux), grads = _value_and_grad(params, mb)
             acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
             acc_loss = acc_loss + loss
             acc_aux = jax.tree.map(jnp.add, acc_aux, aux)
@@ -339,12 +356,38 @@ class Trainer:
             # fetched and thrown host-side after every step.
             from jax.experimental import checkify as _checkify
 
-            # float_checks only: this jax version's index-check rewrite
-            # trips over take_along_axis's fill-mode gather in the loss
-            # (IndexError during trace); OOB indexing on TPU is instead
-            # covered by the clamping semantics + the paged/packed tests.
+            # checkify's error plumbing does not compose with manual
+            # shard_map regions in this jax version (the error pytree's
+            # shapes diverge across the manual boundary) — fail loudly
+            # with the reason instead of a cryptic trace-time TypeError.
+            manual = []
+            if cfg.parallel.sp > 1:
+                manual.append("parallel.sp>1 (ring/Ulysses shard_map)")
+            if cfg.parallel.pp > 1:
+                manual.append("parallel.pp>1 (pipeline shard_map)")
+            if (cfg.model.is_moe and cfg.parallel.ep > 1
+                    and cfg.model.moe_dispatch == "sorted_a2a"):
+                manual.append("moe_dispatch=sorted_a2a (explicit ep a2a)")
+            if cfg.train.grad_quant_bits:
+                manual.append("train.grad_quant_bits (dp shard_map)")
+            if manual:
+                raise ValueError(
+                    "runtime.checkify does not compose with manual "
+                    f"shard_map regions ({', '.join(manual)}); use "
+                    "runtime.debug_nans, or check the step on an "
+                    "SPMD-automatic layout (dp/fsdp/tp/ep-sorted)"
+                )
+            # Full check set: float (nan/inf) AND index (out-of-bounds)
+            # checks. Two rewrites make this possible on this jax version:
+            # the loss's target gather routes through a custom VJP whose
+            # backward is a one-hot product, not a scatter
+            # (models/transformer._gather_target), and the MoE router's
+            # top-k is argsort + one-hot product (models/moe._router_topk)
+            # — checkify's index rewrite crashes on gather's scatter
+            # transpose and on lax.top_k, which previously forced
+            # float_checks-only here.
             checked = jax.jit(
-                _checkify.checkify(base_step, errors=_checkify.float_checks),
+                _checkify.checkify(base_step, errors=_checkify.all_checks),
                 donate_argnums=(0,),
             )
 

@@ -59,6 +59,50 @@ def test_llama_logits_parity():
     )
 
 
+def test_qwen2_logits_parity():
+    """Qwen2-family: the Llama schema plus q/k/v biases and no o bias
+    (attn_bias=True, attn_out_bias=False)."""
+    from orion_tpu.models.convert import from_hf_qwen2
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=10_000.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg)
+    with torch.no_grad():
+        # HF zero-inits the qkv biases; randomize so parity actually
+        # exercises the bias path.
+        for n, p in hf.named_parameters():
+            if n.endswith("proj.bias"):
+                torch.nn.init.normal_(p, std=0.1)
+    cfg = ModelConfig(
+        name="hf-qwen2-tiny", vocab_size=256, max_seq_len=64, d_model=64,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        rope_theta=10_000.0, norm_eps=1e-6, tie_embeddings=False,
+        attn_bias=True, attn_out_bias=False,
+        dtype="float32", param_dtype="float32",
+    )
+    params = from_hf_qwen2(_sd(hf), cfg)
+    ours, _ = forward(params, TOKENS, cfg)
+    np.testing.assert_allclose(
+        np.asarray(ours), _hf_logits(hf, TOKENS), atol=2e-4, rtol=1e-3
+    )
+    # The imported biases are non-trivial (the path is actually exercised).
+    assert float(np.abs(np.asarray(params["blocks"]["attn"]["bq"])).max()) > 0
+
+
+def test_qwen2_rejects_wrong_bias_config():
+    from orion_tpu.models.convert import from_hf_qwen2
+
+    cfg = ModelConfig(name="bad", vocab_size=256, d_model=64, n_layers=2,
+                      n_heads=4, n_kv_heads=2, d_ff=128)
+    with pytest.raises(ValueError, match="attn_bias"):
+        from_hf_qwen2({}, cfg)
+
+
 def test_gpt2_logits_parity():
     hf_cfg = transformers.GPT2Config(
         vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,

@@ -23,6 +23,7 @@ from orion_tpu.train import Trainer
         ("llama3-70b-fsdp", {"fsdp": 8}),
         ("mixtral-8x7b-ep", {"fsdp": 2, "ep": 4}),
         ("mistral-7b-fsdp", {"fsdp": 8}),
+        ("qwen2-7b-fsdp", {"fsdp": 8}),
         # Long-context flagship: full 262144-token sequence through the
         # striped ring (S % sp^2 == 0 holds at sp=8 too).
         ("llama3-8b-256k-ring", {"sp": 8}),

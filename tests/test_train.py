@@ -74,6 +74,24 @@ def test_grad_accum_equivalence():
     assert abs(h1[-1].loss - h2[-1].loss) < 0.3
 
 
+def test_grad_dtype_bf16_tracks_f32():
+    """train.grad_dtype=bfloat16 (the scan-stash bandwidth lever, PERF.md):
+    gradients are computed and stacked in bf16, the optimizer upcasts —
+    the trajectory must track full-precision closely, and compose with
+    grad_accum (f32 accumulator over bf16 micro-grads)."""
+    base = Trainer(_cfg(extra=("train.num_steps=8",))).fit()
+    bf16 = Trainer(
+        _cfg(extra=("train.num_steps=8", "train.grad_dtype=bfloat16"))
+    ).fit()
+    for a, b in zip(base, bf16):
+        np.testing.assert_allclose(b.loss, a.loss, rtol=2e-2, atol=2e-2)
+    acc = Trainer(
+        _cfg(extra=("train.num_steps=8", "train.grad_dtype=bfloat16",
+                    "train.grad_accum=2"))
+    ).fit()
+    assert abs(acc[-1].loss - base[-1].loss) < 0.3
+
+
 def test_train_cli(tmp_path, capsys):
     import train as train_cli
 
@@ -238,3 +256,38 @@ def test_checkify_mode_catches_nan():
     state["params"]["embed"]["tokens"] = emb.at[0, 0].set(jnp.nan)
     with pytest.raises(Exception, match="(?i)nan"):
         t.train_step(state, t.global_batch(1))
+
+
+def test_checkify_covers_moe_and_rejects_manual_shard_map():
+    """The full checkify set runs on MoE configs (the router's argsort
+    top-k replaces lax.top_k, which crashes the index rewrite), and
+    manual-shard_map layouts fail loudly with the reason instead of a
+    cryptic trace-time TypeError."""
+    cfg = _cfg(preset="tiny-mixtral",
+               extra=("runtime.checkify=true", "train.num_steps=1",
+                      "data.batch_size=4"))
+    t = Trainer(cfg)
+    state, _ = t.restore_or_init()
+    _, m = t.train_step(state, t.global_batch(0))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    with pytest.raises(ValueError, match="shard_map"):
+        Trainer(_cfg(extra=("runtime.checkify=true", "parallel.sp=2",
+                            "data.batch_size=4", "data.seq_len=32")))
+
+
+def test_checkify_mode_catches_oob_index():
+    """The full checkify set includes index checks: an out-of-vocab target
+    (which XLA would silently clamp/fill) raises host-side instead of
+    training on garbage. Requires the loss gather's scatter-free custom
+    VJP (models/transformer._gather_target) — the stock gather backward
+    crashes this jax version's index-check rewrite at trace time."""
+    cfg = _cfg(extra=("runtime.checkify=true", "train.num_steps=2"))
+    t = Trainer(cfg)
+    state, _ = t.restore_or_init()
+    batch = dict(t.global_batch(0))
+    bad = np.asarray(jax.device_get(batch["targets"])).copy()
+    bad[0, 0] = cfg.model.vocab_size + 7   # out of vocab range
+    batch["targets"] = jax.device_put(bad, batch["targets"].sharding)
+    with pytest.raises(Exception, match="(?i)out.of.bounds|index"):
+        t.train_step(state, batch)
