@@ -45,7 +45,7 @@ class ModelConfig:
     rope_theta: float = 500_000.0
     norm: str = "rmsnorm"           # "rmsnorm" | "layernorm"
     norm_eps: float = 1e-5
-    activation: str = "swiglu"      # "swiglu" | "gelu"
+    activation: str = "swiglu"      # "swiglu" | "geglu" | "gelu"
     tie_embeddings: bool = True
     attn_bias: bool = False
     # Output-projection bias; None follows attn_bias. Qwen2-family models
@@ -60,6 +60,21 @@ class ModelConfig:
     # with sequence parallelism: every SP method threads the window, and
     # the plain ring truncates its scan to O(window) communication.
     sliding_window: Optional[int] = None
+    # Interleaved local/global attention (Gemma-family): the window applies
+    # only to layers l with l % pattern != pattern-1 (pattern=2 => even
+    # layers local, odd global). None => the window applies to every layer.
+    # With a pattern, serving keeps FULL-context pages (global layers read
+    # the whole history), so only the attention masks are windowed.
+    sliding_window_pattern: Optional[int] = None
+    # Gemma-family block/embedding details:
+    post_norms: bool = False          # extra norms AFTER attention and MLP
+    norm_scale_plus_one: bool = False  # rmsnorm multiplies by (1 + w)
+    embed_scale: bool = False          # embeddings scaled by sqrt(d_model)
+    # Net attention logit scale (default head_dim**-0.5). Gemma-2 uses
+    # query_pre_attn_scalar**-0.5, which differs from head_dim for 27B.
+    query_scale: Optional[float] = None
+    # Final LM-head logit soft-capping (Gemma-2): cap * tanh(logits/cap).
+    final_logit_softcap: Optional[float] = None
 
     # Mixture-of-experts (0 experts => dense MLP).
     n_experts: int = 0
@@ -141,6 +156,26 @@ class ModelConfig:
             if self.attn_out_bias is None else self.attn_out_bias
         )
 
+    @property
+    def is_gated_mlp(self) -> bool:
+        """Gated feed-forwards (a w_gate matrix): SwiGLU and GeGLU."""
+        return self.activation in ("swiglu", "geglu")
+
+    def layer_window(self, layer: int) -> Optional[int]:
+        """The sliding window for a given layer index (None = global).
+
+        With sliding_window_pattern, only layers l % pattern != pattern-1
+        are windowed (Gemma-family local/global interleave); the argument
+        must be a PYTHON int (the window is static in every kernel), so
+        layer scans group layers by pattern position.
+        """
+        if self.sliding_window is None:
+            return None
+        p = self.sliding_window_pattern
+        if p is None or layer % p != p - 1:
+            return self.sliding_window
+        return None
+
     def num_params(self) -> int:
         """Approximate parameter count (embeddings + blocks + norms)."""
         h, v, L = self.d_model, self.vocab_size, self.n_layers
@@ -149,7 +184,7 @@ class ModelConfig:
         kv = 2 * h * self.n_kv_heads * hd
         o = self.n_heads * hd * h
         attn = q + kv + o
-        if self.activation == "swiglu":
+        if self.is_gated_mlp:
             mlp = 3 * h * self.d_ff
         else:
             mlp = 2 * h * self.d_ff
@@ -171,7 +206,7 @@ class ModelConfig:
         h, L = self.d_model, self.n_layers
         hd = self.resolved_head_dim
         attn = self.n_heads * hd * h + 2 * self.n_kv_heads * hd * h + self.n_heads * hd * h
-        if self.activation == "swiglu":
+        if self.is_gated_mlp:
             mlp = 3 * h * self.d_ff
         else:
             mlp = 2 * h * self.d_ff
@@ -593,6 +628,31 @@ def _p_llama8b_256k() -> Config:
     )
 
 
+@register_preset("gemma2-9b-fsdp")
+def _p_gemma2_9b() -> Config:
+    """Gemma-2-9B: interleaved local/global attention (window on even
+    layers), pre+post norms with (1+w) RMSNorm, GeGLU, sqrt(d) embedding
+    scale, dual logit softcaps, tied embeddings. Weights import via
+    models.convert.from_hf_gemma2."""
+    return Config(
+        model=ModelConfig(
+            name="gemma2-9b", vocab_size=256_128, max_seq_len=8192,
+            d_model=3584, n_layers=42, n_heads=16, n_kv_heads=8,
+            head_dim=256, d_ff=14336, pos_embedding="rope",
+            rope_theta=10_000.0, norm="rmsnorm", norm_eps=1e-6,
+            norm_scale_plus_one=True, post_norms=True, embed_scale=True,
+            activation="geglu", tie_embeddings=True,
+            sliding_window=4096, sliding_window_pattern=2,
+            attn_logit_softcap=50.0, final_logit_softcap=30.0,
+            query_scale=256.0 ** -0.5,
+            dtype="bfloat16", kernels="pallas", remat="full",
+        ),
+        parallel=ParallelConfig(fsdp=8),
+        data=DataConfig(batch_size=32, seq_len=8192),
+        optimizer=OptimizerConfig(learning_rate=3e-4),
+    )
+
+
 @register_preset("qwen2-7b-fsdp")
 def _p_qwen2_7b() -> Config:
     """Qwen2/Qwen2.5-7B: Llama-family architecture + q/k/v projection
@@ -682,6 +742,28 @@ def _p_tiny_mixtral() -> Config:
                              n_heads=4, n_kv_heads=2, d_ff=128, n_experts=4,
                              n_experts_per_token=2, dtype="float32",
                              kernels="xla", remat="none"),
+        data=DataConfig(batch_size=4, seq_len=64),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=5),
+        train=TrainConfig(num_steps=20, log_interval=5),
+    )
+
+
+@register_preset("tiny-gemma2")
+def _p_tiny_gemma2() -> Config:
+    """Tiny Gemma-2-family model (interleaved local/global attention,
+    post-norms, GeGLU, dual softcaps) for CPU tests."""
+    return Config(
+        model=ModelConfig(
+            name="tiny-gemma2", vocab_size=256, max_seq_len=128,
+            d_model=64, n_layers=4, n_heads=4, n_kv_heads=2, head_dim=16,
+            d_ff=128, pos_embedding="rope", rope_theta=10_000.0,
+            norm="rmsnorm", norm_eps=1e-6, norm_scale_plus_one=True,
+            post_norms=True, embed_scale=True, activation="geglu",
+            tie_embeddings=True, sliding_window=16,
+            sliding_window_pattern=2, attn_logit_softcap=50.0,
+            final_logit_softcap=30.0, query_scale=16.0 ** -0.5,
+            dtype="float32", kernels="xla", remat="none",
+        ),
         data=DataConfig(batch_size=4, seq_len=64),
         optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=5),
         train=TrainConfig(num_steps=20, log_interval=5),

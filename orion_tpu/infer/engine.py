@@ -110,6 +110,15 @@ class InferenceEngine:
         self._admit_seq = itertools.count()
         self._key = jax.random.key(seed)
         self.preemptions = 0
+        # Page-management window: with interleaved local/global layers
+        # (sliding_window_pattern) the GLOBAL layers read the whole
+        # history, so pages never die and rolling/dead-on-arrival page
+        # logic must treat the model as unwindowed; only the attention
+        # masks are per-layer windowed (runner/cfg.layer_window).
+        self.page_window = (
+            self.mcfg.sliding_window
+            if self.mcfg.sliding_window_pattern is None else None
+        )
         self._dev_span = 0.0
         self.timing = {
             "device_s": 0.0, "host_s": 0.0, "windows": 0, "steps": 0,
@@ -358,7 +367,7 @@ class InferenceEngine:
         candidate-point check would look.
         """
         icfg = self.icfg
-        W, Wd, psz = self.mcfg.sliding_window, icfg.decode_window, self.psz
+        W, Wd, psz = self.page_window, icfg.decode_window, self.psz
         ctxs = np.arange(min_ctx, max_ctx + 1, dtype=np.int64)
         chunk = icfg.prefill_chunk
         bucket = np.minimum(-(-ctxs // chunk) * chunk, icfg.max_seq_len)
@@ -381,7 +390,7 @@ class InferenceEngine:
         wholly before that are dead — never allocated at admission, and
         freed as the window rolls past them (_roll_window). 0 without SWA.
         """
-        W = self.mcfg.sliding_window
+        W = self.page_window
         if W is None:
             return 0
         return max(context_len - W + 1, 0) // self.psz
@@ -394,7 +403,7 @@ class InferenceEngine:
         O(window), not O(context). Freed logical slots keep a None
         placeholder so page indices stay position-aligned; their table
         entries point at scratch page 0 (never read)."""
-        if self.mcfg.sliding_window is None:
+        if self.page_window is None:
             return
         for req in self.slots:
             if req is None or req.slot is None:

@@ -55,21 +55,55 @@ Cache = dict[str, jax.Array]
 
 
 def _scan_layers(params: Params, cfg: ModelConfig, body, init_carry):
-    """Run ``body(carry, bp, l) -> carry`` over all layers; ``l`` is the
-    layer index (traced under scan, static ints otherwise)."""
+    """Run ``body(carry, bp, l, j) -> carry`` over all layers.
+
+    ``l`` is the layer index (traced under scan, static ints otherwise);
+    ``j`` is the STATIC pattern position (l % sliding_window_pattern, or 0
+    without a pattern) — the sliding window is static in every kernel, so
+    interleaved local/global models (Gemma-family) scan over GROUPS of
+    ``pattern`` layers with one body call per static position.
+    """
     L = cfg.n_layers
+    pattern = (
+        cfg.sliding_window_pattern
+        if cfg.sliding_window is not None else None
+    )
     if cfg.scan_layers:
-        def scan_body(carry, xs):
-            bp, l = xs
-            return body(carry, bp, l), None
+        if pattern is None:
+            def scan_body(carry, xs):
+                bp, l = xs
+                return body(carry, bp, l, 0), None
+
+            carry, _ = jax.lax.scan(
+                scan_body, init_carry, (params["blocks"], jnp.arange(L))
+            )
+            return carry
+        if L % pattern:
+            raise ValueError(
+                f"n_layers={L} must be divisible by "
+                f"sliding_window_pattern={pattern}"
+            )
+        grouped = jax.tree.map(
+            lambda a: a.reshape(L // pattern, pattern, *a.shape[1:]),
+            params["blocks"],
+        )
+
+        def group_body(carry, xs):
+            gbp, g = xs
+            for j in range(pattern):
+                carry = body(
+                    carry, jax.tree.map(lambda a: a[j], gbp),
+                    g * pattern + j, j,
+                )
+            return carry, None
 
         carry, _ = jax.lax.scan(
-            scan_body, init_carry, (params["blocks"], jnp.arange(L))
+            group_body, init_carry, (grouped, jnp.arange(L // pattern))
         )
         return carry
     carry = init_carry
     for l, bp in enumerate(params["blocks"]):
-        carry = body(carry, bp, l)
+        carry = body(carry, bp, l, l % pattern if pattern else 0)
     return carry
 
 
@@ -104,20 +138,25 @@ def prefill_step(
     # one dispatch instead of bucket-padded compute per bucket.
     seg = (positions < lengths[:, None]).astype(jnp.int32)
 
-    def body(carry, bp, l):
+    def body(carry, bp, l, j):
         x, cc = carry
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         out = attention(
             q, k, v, causal=True,
             q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
-            window=cfg.sliding_window,
+            window=cfg.layer_window(j),
             block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
         )
-        x = x + out_proj(out, bp["attn"], cfg)
+        a = out_proj(out, bp["attn"], cfg)
+        if cfg.post_norms:
+            a = _norm(a, bp["post_attn_norm"], cfg)
+        x = x + a
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)
+        if cfg.post_norms:
+            y = _norm(y, bp["post_mlp_norm"], cfg)
         x = x + y
         # Scatter this layer's K/V pages into the pool (in-place on the
         # carried flat pool). Positions beyond each row's `length` hold
@@ -176,22 +215,19 @@ def _decode_core(
 
     page_idx = page_table[batch_idx, write_pos // psz]   # [B]
     offset = write_pos % psz                             # [B]
-    # KV positions valid after the write: arange <= write_pos (and within
-    # the sliding window when configured: write_pos - kv_pos < window).
+    # KV positions valid after the write: arange <= write_pos; the
+    # (per-layer) sliding window narrows it inside the body.
     kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
-    kv_mask = kv_arange <= write_pos[:, None, None]      # [B, 1, P*psz]
-    if cfg.sliding_window is not None:
-        kv_mask &= (
-            kv_arange >= (write_pos - cfg.sliding_window + 1)[:, None, None]
-        )
+    kv_base_mask = kv_arange <= write_pos[:, None, None]  # [B, 1, P*psz]
 
     from orion_tpu.ops._dispatch import resolve_impl
 
     use_pallas, interpret = resolve_impl(cfg.kernels)
 
-    def body(carry, bp, l):
+    def body(carry, bp, l, j):
         x, cc = carry
         cc = dict(cc)
+        win = cfg.layer_window(j)
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
         K, H = k.shape[2], k.shape[3]
@@ -210,7 +246,7 @@ def _decode_core(
                 layer_base=l * NP,
                 k_new=k[:, 0], v_new=v[:, 0],
                 logit_softcap=cfg.attn_logit_softcap,
-                window=cfg.sliding_window,
+                window=win,
                 interpret=interpret,
                 k_scale=cc.get("k_scale"),
                 v_scale=cc.get("v_scale"),
@@ -249,10 +285,20 @@ def _decode_core(
                 v_ctx = v_ctx.astype(q.dtype)
             k_ctx = k_ctx.reshape(B, P * psz, K, H)
             v_ctx = v_ctx.reshape(B, P * psz, K, H)
+            kv_mask = kv_base_mask
+            if win is not None:
+                kv_mask = kv_mask & (
+                    kv_arange >= (write_pos - win + 1)[:, None, None]
+                )
             out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
-        x = x + out_proj(out, bp["attn"], cfg)
+        a = out_proj(out, bp["attn"], cfg)
+        if cfg.post_norms:
+            a = _norm(a, bp["post_attn_norm"], cfg)
+        x = x + a
         h2 = _norm(x, bp["mlp_norm"], cfg)
         y, _ = mlp_or_moe(h2, bp, cfg)
+        if cfg.post_norms:
+            y = _norm(y, bp["post_mlp_norm"], cfg)
         return x + y, cc
 
     x = embed(params, tokens[:, None], positions, cfg)

@@ -8,6 +8,7 @@ the reference's torch world import via ``orion_tpu.models.convert``
 """
 
 from orion_tpu.models.convert import (
+    from_hf_gemma2,
     from_hf_gpt2,
     from_hf_llama,
     from_hf_mixtral,
@@ -22,6 +23,7 @@ from orion_tpu.models.transformer import (
 
 __all__ = [
     "forward",
+    "from_hf_gemma2",
     "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",

@@ -224,6 +224,79 @@ def from_hf_qwen2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     return _cast(cfg, params)
 
 
+def from_hf_gemma2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
+    """Gemma-2-family ``Gemma2ForCausalLM`` state dict.
+
+    Llama-style projections plus the Gemma block shape: pre AND post norms
+    around both sublayers ((1+w) RMSNorm), GeGLU MLP, tied embeddings,
+    sqrt(d_model) embedding scale, interleaved local/global attention.
+    cfg should set post_norms=True, norm_scale_plus_one=True,
+    embed_scale=True, activation='geglu', tie_embeddings=True,
+    sliding_window_pattern=2 (+ the softcaps and query_scale).
+    """
+    need = dict(post_norms=True, norm_scale_plus_one=True,
+                embed_scale=True, tie_embeddings=True)
+    bad = {k: getattr(cfg, k) for k, v in need.items()
+           if getattr(cfg, k) is not v}
+    if cfg.activation != "geglu":
+        bad["activation"] = cfg.activation
+    # Attention-math knobs: without these the import loads cleanly and
+    # produces silently wrong logits (the parity test's negative control
+    # proves e.g. a uniform-window config diverges from HF).
+    for k in ("sliding_window", "sliding_window_pattern", "query_scale",
+              "attn_logit_softcap", "final_logit_softcap"):
+        if getattr(cfg, k) is None:
+            bad[k] = None
+    if bad:
+        raise ValueError(
+            f"Gemma-2-family configs need {need}, activation='geglu', and "
+            f"non-None sliding_window(+pattern)/query_scale/softcaps; "
+            f"got {bad}"
+        )
+    L = cfg.n_layers
+
+    def t(name):  # torch Linear [out, in] -> [in, out]
+        return np.ascontiguousarray(sd[name].T)
+
+    blocks = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        blocks.append({
+            "attn_norm": {
+                "scale": np.asarray(sd[p + "input_layernorm.weight"])
+            },
+            "post_attn_norm": {
+                "scale": np.asarray(
+                    sd[p + "post_attention_layernorm.weight"])
+            },
+            "mlp_norm": {
+                "scale": np.asarray(
+                    sd[p + "pre_feedforward_layernorm.weight"])
+            },
+            "post_mlp_norm": {
+                "scale": np.asarray(
+                    sd[p + "post_feedforward_layernorm.weight"])
+            },
+            "attn": {
+                "wq": t(p + "self_attn.q_proj.weight"),
+                "wk": t(p + "self_attn.k_proj.weight"),
+                "wv": t(p + "self_attn.v_proj.weight"),
+                "wo": t(p + "self_attn.o_proj.weight"),
+            },
+            "mlp": {
+                "w_gate": t(p + "mlp.gate_proj.weight"),
+                "w_in": t(p + "mlp.up_proj.weight"),
+                "w_out": t(p + "mlp.down_proj.weight"),
+            },
+        })
+    params: Params = {
+        "embed": {"tokens": np.asarray(sd["model.embed_tokens.weight"])},
+        "final_norm": {"scale": np.asarray(sd["model.norm.weight"])},
+        "blocks": _stack(cfg, blocks),
+    }
+    return _cast(cfg, params)
+
+
 def from_hf_gpt2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
     """GPT-2 ``GPT2LMHeadModel`` state dict (Conv1D stores [in, out])."""
     D = cfg.d_model

@@ -178,9 +178,11 @@ def _expert_ffn(xin: jax.Array, params: dict[str, Any], cfg: ModelConfig
                 ) -> jax.Array:
     """Batched expert feed-forward on capacity buckets. xin: [E, B, C, D]."""
     h_in = jnp.einsum("ebcd,edf->ebcf", xin, params["w_in"])
-    if cfg.activation == "swiglu":
+    if cfg.is_gated_mlp:
+        from orion_tpu.models.transformer import _gate_act
+
         h_gate = jnp.einsum("ebcd,edf->ebcf", xin, params["w_gate"])
-        h = jax.nn.silu(h_gate) * h_in
+        h = _gate_act(cfg)(h_gate) * h_in
     else:
         h = jax.nn.gelu(h_in)
     return jnp.einsum("ebcf,efd->ebcd", h, params["w_out"])

@@ -49,9 +49,15 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 
     keys = iter(jax.random.split(key, 64))
 
+    def norm_scale():
+        # (1 + w) norms (Gemma) initialize w at zero => identity scale.
+        if cfg.norm_scale_plus_one:
+            return jnp.zeros((D,), pdt)
+        return jnp.ones((D,), pdt)
+
     params: Params = {
         "embed": {"tokens": _normal(next(keys), (V, D), pdt, std)},
-        "final_norm": {"scale": jnp.ones((D,), pdt)},
+        "final_norm": {"scale": norm_scale()},
     }
     if cfg.pos_embedding == "learned":
         params["embed"]["positions"] = _normal(
@@ -65,8 +71,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     def init_block(bkey: jax.Array) -> Params:
         bkeys = iter(jax.random.split(bkey, 16))
         block: Params = {
-            "attn_norm": {"scale": jnp.ones((D,), pdt)},
-            "mlp_norm": {"scale": jnp.ones((D,), pdt)},
+            "attn_norm": {"scale": norm_scale()},
+            "mlp_norm": {"scale": norm_scale()},
             "attn": {
                 "wq": _normal(next(bkeys), (D, N * H), pdt, std),
                 "wk": _normal(next(bkeys), (D, K * H), pdt, std),
@@ -77,6 +83,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         if cfg.norm == "layernorm":
             block["attn_norm"]["bias"] = jnp.zeros((D,), pdt)
             block["mlp_norm"]["bias"] = jnp.zeros((D,), pdt)
+        if cfg.post_norms:
+            block["post_attn_norm"] = {"scale": norm_scale()}
+            block["post_mlp_norm"] = {"scale": norm_scale()}
         if cfg.attn_bias:
             block["attn"]["bq"] = jnp.zeros((N * H,), pdt)
             block["attn"]["bk"] = jnp.zeros((K * H,), pdt)
@@ -90,14 +99,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "w_in": _normal(next(bkeys), (E, D, F), pdt, std),
                 "w_out": _normal(next(bkeys), (E, F, D), pdt, resid_std),
             }
-            if cfg.activation == "swiglu":
+            if cfg.is_gated_mlp:
                 block["moe"]["w_gate"] = _normal(next(bkeys), (E, D, F), pdt, std)
         else:
             block["mlp"] = {
                 "w_in": _normal(next(bkeys), (D, F), pdt, std),
                 "w_out": _normal(next(bkeys), (F, D), pdt, resid_std),
             }
-            if cfg.activation == "swiglu":
+            if cfg.is_gated_mlp:
                 block["mlp"]["w_gate"] = _normal(next(bkeys), (D, F), pdt, std)
             if cfg.mlp_bias:
                 block["mlp"]["b_in"] = jnp.zeros((F,), pdt)
@@ -134,6 +143,9 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
     if cfg.norm == "layernorm":
         block["attn_norm"]["bias"] = lead + ("embed",)
         block["mlp_norm"]["bias"] = lead + ("embed",)
+    if cfg.post_norms:
+        block["post_attn_norm"] = {"scale": lead + ("embed",)}
+        block["post_mlp_norm"] = {"scale": lead + ("embed",)}
     if cfg.attn_bias:
         block["attn"]["bq"] = lead + ("heads",)
         block["attn"]["bk"] = lead + ("kv_heads",)
@@ -146,14 +158,14 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
             "w_in": lead + ("expert", "embed", "mlp"),
             "w_out": lead + ("expert", "mlp", "embed"),
         }
-        if cfg.activation == "swiglu":
+        if cfg.is_gated_mlp:
             block["moe"]["w_gate"] = lead + ("expert", "embed", "mlp")
     else:
         block["mlp"] = {
             "w_in": lead + ("embed", "mlp"),
             "w_out": lead + ("mlp", "embed"),
         }
-        if cfg.activation == "swiglu":
+        if cfg.is_gated_mlp:
             block["mlp"]["w_gate"] = lead + ("embed", "mlp")
         if cfg.mlp_bias:
             block["mlp"]["b_in"] = lead + ("mlp",)
@@ -178,10 +190,23 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 
+def _gate_act(cfg: ModelConfig):
+    """Gating nonlinearity for gated MLPs: SiLU (SwiGLU) or tanh-approx
+    GELU (GeGLU, the Gemma-family gate)."""
+    if cfg.activation == "swiglu":
+        return jax.nn.silu
+    return functools.partial(jax.nn.gelu, approximate=True)
+
+
 def _norm(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    scale = p["scale"]
+    if cfg.norm_scale_plus_one:
+        # Gemma-family RMSNorm parameterization: x_hat * (1 + w) (weights
+        # initialized at zero); same kernels, shifted scale.
+        scale = scale + 1.0
     if cfg.norm == "rmsnorm":
-        return ops.rmsnorm(x, p["scale"], eps=cfg.norm_eps, impl=cfg.kernels)
-    return ops.layernorm(x, p["scale"], p.get("bias"), eps=cfg.norm_eps)
+        return ops.rmsnorm(x, scale, eps=cfg.norm_eps, impl=cfg.kernels)
+    return ops.layernorm(x, scale, p.get("bias"), eps=cfg.norm_eps)
 
 
 def embed(
@@ -190,6 +215,10 @@ def embed(
     """Token (+ learned position) embedding; shared by training forward and
     the inference cache runner."""
     x = params["embed"]["tokens"].astype(jnp.dtype(cfg.dtype))[tokens]
+    if cfg.embed_scale:
+        # Gemma-family: embeddings scaled by sqrt(d_model), rounded in the
+        # activation dtype (matches the HF normalizer semantics).
+        x = x * jnp.asarray(cfg.d_model ** 0.5, x.dtype)
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"].astype(x.dtype)[positions]
     return x
@@ -204,7 +233,11 @@ def unembed(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         )
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, _load_w(params["lm_head"], x.dtype))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if cfg.final_logit_softcap is not None:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def qkv_proj(
@@ -233,6 +266,11 @@ def qkv_proj(
     if cfg.pos_embedding == "rope":
         q = ops.apply_rope(q, positions, theta=cfg.rope_theta, impl=cfg.kernels)
         k = ops.apply_rope(k, positions, theta=cfg.rope_theta, impl=cfg.kernels)
+    if cfg.query_scale is not None:
+        # Net attention scale cfg.query_scale instead of head_dim**-0.5
+        # (Gemma-2's query_pre_attn_scalar**-0.5): every attention kernel
+        # divides by sqrt(head_dim), so pre-multiply q by the ratio.
+        q = q * jnp.asarray(cfg.query_scale * (H ** 0.5), q.dtype)
     return q, k, v
 
 
@@ -268,7 +306,10 @@ def _attn_block(
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
     mesh: Optional[Any] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
+    """``window`` is THIS layer's sliding window (already resolved through
+    cfg.layer_window for interleaved local/global models)."""
     q, k, v = qkv_proj(x, p, cfg, positions)
 
     sp_active = (
@@ -293,7 +334,7 @@ def _attn_block(
             q_segment_ids=segment_ids,
             kv_segment_ids=segment_ids,
             logit_softcap=cfg.attn_logit_softcap,
-            window=cfg.sliding_window,
+            window=window,
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
@@ -315,7 +356,7 @@ def _attn_block(
             # blocks may skip their compute in the flash kernel.
             seg_pad_zero=True,
             logit_softcap=cfg.attn_logit_softcap,
-            window=cfg.sliding_window,
+            window=window,
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
@@ -328,9 +369,9 @@ def _mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     h_in = jnp.einsum("bsd,df->bsf", x, _load_w(p["w_in"], dtype))
     if cfg.mlp_bias:
         h_in = h_in + p["b_in"].astype(dtype)
-    if cfg.activation == "swiglu":
+    if cfg.is_gated_mlp:
         h_gate = jnp.einsum("bsd,df->bsf", x, _load_w(p["w_gate"], dtype))
-        h = jax.nn.silu(h_gate) * h_in
+        h = _gate_act(cfg)(h_gate) * h_in
     else:
         h = jax.nn.gelu(h_in)
     y = jnp.einsum("bsf,fd->bsd", h, _load_w(p["w_out"], dtype))
@@ -346,19 +387,29 @@ def _block(
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
     mesh: Optional[Any] = None,
+    window: Optional[int] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One transformer block. Returns (x, moe_aux_loss).
+
+    ``window``: this layer's resolved sliding window. With cfg.post_norms
+    (Gemma-family) each sublayer output is normalized again before the
+    residual add.
 
     jax.named_scope annotations label the phases in profiler traces
     (SURVEY.md §6 "Tracing / profiling": xprof shows attention vs mlp time
     per block without guessing from fused-op names).
     """
     with jax.named_scope("attention"):
-        x = x + _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
-                            positions, segment_ids, mesh)
+        a = _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
+                        positions, segment_ids, mesh, window)
+        if cfg.post_norms:
+            a = _norm(a, bp["post_attn_norm"], cfg)
+        x = x + a
     with jax.named_scope("mlp_moe"):
         h = _norm(x, bp["mlp_norm"], cfg)
         y, aux = mlp_or_moe(h, bp, cfg, mesh)
+        if cfg.post_norms:
+            y = _norm(y, bp["post_mlp_norm"], cfg)
     return x + y, aux
 
 
@@ -409,21 +460,29 @@ def _hidden_states(
     with jax.named_scope("embed"):
         x = embed(params, tokens, positions, cfg)
 
-    def block_fn(carry, bp):
-        pos = positions
-        if pos.shape[0] != carry.shape[0]:
-            pos = jnp.broadcast_to(pos[:1], (carry.shape[0], pos.shape[1]))
-        y, aux = _block(carry, bp, cfg, pos, segment_ids, mesh)
-        return y, aux
+    def make_block_fn(window: Optional[int]):
+        def block_fn(carry, bp):
+            pos = positions
+            if pos.shape[0] != carry.shape[0]:
+                pos = jnp.broadcast_to(
+                    pos[:1], (carry.shape[0], pos.shape[1])
+                )
+            return _block(carry, bp, cfg, pos, segment_ids, mesh, window)
 
-    if cfg.remat == "full":
-        block_fn = jax.checkpoint(block_fn)
-    elif cfg.remat == "dots":
-        block_fn = jax.checkpoint(
-            block_fn,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-        )
+        if cfg.remat == "full":
+            return jax.checkpoint(block_fn)
+        if cfg.remat == "dots":
+            return jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.
+                checkpoint_dots_with_no_batch_dims,
+            )
+        return block_fn
 
+    pattern = (
+        cfg.sliding_window_pattern
+        if cfg.sliding_window is not None else None
+    )
     pp_active = (
         cfg.pipeline_axis is not None
         and mesh is not None
@@ -437,25 +496,64 @@ def _hidden_states(
                 "pipeline parallelism does not support packed sequences "
                 "(segment_ids/custom positions are per-row state)"
             )
+        if pattern is not None:
+            raise ValueError(
+                "pipeline parallelism does not support "
+                "sliding_window_pattern (layers are not homogeneous)"
+            )
         from orion_tpu.parallel.pipeline import pipeline_forward
 
         x, moe_aux = pipeline_forward(
             x,
             params["blocks"],
-            block_fn,
+            make_block_fn(cfg.sliding_window),
             mesh,
             axis=cfg.pipeline_axis,
             num_microbatches=cfg.pp_microbatches,
         )
     elif cfg.scan_layers:
-        x, aux = jax.lax.scan(
-            block_fn, x, params["blocks"], unroll=cfg.scan_unroll
-        )
-        moe_aux = aux.sum()
+        if pattern is None:
+            x, aux = jax.lax.scan(
+                make_block_fn(cfg.layer_window(0)), x, params["blocks"],
+                unroll=cfg.scan_unroll,
+            )
+            moe_aux = aux.sum()
+        else:
+            # Interleaved local/global layers (Gemma-family): the window is
+            # STATIC in every kernel, so scan over GROUPS of `pattern`
+            # layers, each group position having its own (static) window.
+            L = cfg.n_layers
+            if L % pattern:
+                raise ValueError(
+                    f"n_layers={L} must be divisible by "
+                    f"sliding_window_pattern={pattern}"
+                )
+            fns = [make_block_fn(cfg.layer_window(j))
+                   for j in range(pattern)]
+            grouped = jax.tree.map(
+                lambda a: a.reshape(
+                    L // pattern, pattern, *a.shape[1:]
+                ),
+                params["blocks"],
+            )
+
+            def group_fn(carry, gbp):
+                aux_t = jnp.zeros((), jnp.float32)
+                for j, f in enumerate(fns):
+                    carry, aux = f(
+                        carry, jax.tree.map(lambda a: a[j], gbp)
+                    )
+                    aux_t = aux_t + aux
+                return carry, aux_t
+
+            x, aux = jax.lax.scan(
+                group_fn, x, grouped, unroll=cfg.scan_unroll
+            )
+            moe_aux = aux.sum()
     else:
         moe_aux = jnp.zeros((), jnp.float32)
-        for bp in params["blocks"]:
-            x, aux = block_fn(x, bp)
+        for l, bp in enumerate(params["blocks"]):
+            x, aux = make_block_fn(cfg.layer_window(l))(x, bp)
             moe_aux = moe_aux + aux
     return x, moe_aux
 

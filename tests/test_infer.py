@@ -37,14 +37,33 @@ def _ref_generate(params, mcfg, prompt, n):
     return toks[len(prompt):]
 
 
-@pytest.mark.parametrize("preset", ["tiny-llama", "tiny", "tiny-mixtral"])
+@pytest.mark.parametrize(
+    "preset", ["tiny-llama", "tiny", "tiny-mixtral", "tiny-gemma2"]
+)
 def test_engine_matches_full_forward(preset):
     """Paged-cache decode must reproduce the no-cache forward exactly
-    (greedy), across the model zoo: RoPE/GQA, learned-pos/LayerNorm, MoE."""
+    (greedy), across the model zoo: RoPE/GQA, learned-pos/LayerNorm, MoE,
+    and Gemma-2's interleaved local/global windows + post-norms + dual
+    softcaps (full-context pages with per-layer masks)."""
     cfg, params = _setup(preset)
     prompt = [5, 3, 9, 250, 17]
     ref = _ref_generate(params, cfg.model, prompt, 8)
     out = InferenceEngine(cfg, params).generate([prompt], 8)[0]
+    assert out == ref
+
+
+def test_gemma2_engine_beyond_window():
+    """Gemma-2 serving past the sliding window: local layers mask to the
+    last W positions while global layers read the whole history (pages
+    must NOT roll — page_window is None under a pattern); still exactly
+    reproduces the full forward."""
+    cfg, params = _setup("tiny-gemma2")
+    eng = InferenceEngine(cfg, params)
+    assert eng.page_window is None          # full-context pages kept
+    prompt = [5, 3, 9, 250, 17]
+    n = 24                                  # context 29 >> window 16
+    ref = _ref_generate(params, cfg.model, prompt, n)
+    out = eng.generate([prompt], n)[0]
     assert out == ref
 
 

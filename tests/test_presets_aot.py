@@ -24,6 +24,9 @@ from orion_tpu.train import Trainer
         ("mixtral-8x7b-ep", {"fsdp": 2, "ep": 4}),
         ("mistral-7b-fsdp", {"fsdp": 8}),
         ("qwen2-7b-fsdp", {"fsdp": 8}),
+        # Gemma-2: interleaved local/global grouped layer scan, post-norms,
+        # dual softcaps at full 9B size.
+        ("gemma2-9b-fsdp", {"fsdp": 8}),
         # Long-context flagship: full 262144-token sequence through the
         # striped ring (S % sp^2 == 0 holds at sp=8 too).
         ("llama3-8b-256k-ring", {"sp": 8}),

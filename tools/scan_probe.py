@@ -23,8 +23,22 @@ import sys
 PROBE_STEPS = 12  # enough for compile + a few steady-state steps
 
 
+def _parse_stdout(out, text):
+    for line in (text or "").splitlines():
+        if line.startswith("{") and "llama_flagship_train_mfu" in line:
+            j = json.loads(line)
+            out["mfu_pct"] = j.get("value")
+            out["tok_s_chip"] = j.get("tokens_per_sec_per_chip")
+        if line.startswith("done:"):
+            out["final_line"] = line.strip()
+    return out
+
+
 def run_candidate(name, overrides, budget_s, cpu):
-    args = [sys.executable, "bench.py", "train.log_interval=1000",
+    # --train-only: the probe budget is for the TRAIN compile+steps; the
+    # serving benches are irrelevant here and must not consume it.
+    args = [sys.executable, "bench.py", "--train-only",
+            "train.log_interval=1000",
             f"train.num_steps={PROBE_STEPS}"] + overrides
     if cpu:
         # The bench probes the accelerator; force the CPU path via the
@@ -37,21 +51,20 @@ def run_candidate(name, overrides, budget_s, cpu):
     try:
         r = subprocess.run(args, capture_output=True, text=True,
                            timeout=budget_s)
-    except subprocess.TimeoutExpired:
-        return {"candidate": name, "status": "TIMEOUT",
-                "budget_s": budget_s}
+    except subprocess.TimeoutExpired as e:
+        # Keep any already-captured result line: a candidate that measured
+        # its MFU and then hung is a RESULT with a caveat, not a loss.
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        return _parse_stdout(
+            {"candidate": name, "status": "TIMEOUT", "budget_s": budget_s},
+            stdout,
+        )
     if r.returncode != 0:
         return {"candidate": name, "status": "ERROR",
                 "tail": r.stdout[-200:] + r.stderr[-200:]}
-    out = {"candidate": name, "status": "OK"}
-    for line in r.stdout.splitlines():
-        if line.startswith("{") and "llama_flagship_train_mfu" in line:
-            j = json.loads(line)
-            out["mfu_pct"] = j.get("value")
-            out["tok_s_chip"] = j.get("tokens_per_sec_per_chip")
-        if line.startswith("done:"):
-            out["final_line"] = line.strip()
-    return out
+    return _parse_stdout({"candidate": name, "status": "OK"}, r.stdout)
 
 
 def main() -> int:
