@@ -636,7 +636,7 @@ def _p_gemma2_9b() -> Config:
     models.convert.from_hf_gemma2."""
     return Config(
         model=ModelConfig(
-            name="gemma2-9b", vocab_size=256_128, max_seq_len=8192,
+            name="gemma2-9b", vocab_size=256_000, max_seq_len=8192,
             d_model=3584, n_layers=42, n_heads=16, n_kv_heads=8,
             head_dim=256, d_ff=14336, pos_embedding="rope",
             rope_theta=10_000.0, norm="rmsnorm", norm_eps=1e-6,

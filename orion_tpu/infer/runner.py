@@ -145,6 +145,7 @@ def prefill_step(
         out = attention(
             q, k, v, causal=True,
             q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
+            logit_softcap=cfg.attn_logit_softcap,
             window=cfg.layer_window(j),
             block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
@@ -290,7 +291,10 @@ def _decode_core(
                 kv_mask = kv_mask & (
                     kv_arange >= (write_pos - win + 1)[:, None, None]
                 )
-            out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=kv_mask)
+            out = attention_xla(
+                q, k_ctx, v_ctx, causal=False, mask=kv_mask,
+                logit_softcap=cfg.attn_logit_softcap,
+            )
         a = out_proj(out, bp["attn"], cfg)
         if cfg.post_norms:
             a = _norm(a, bp["post_attn_norm"], cfg)

@@ -121,6 +121,17 @@ def to_hf_llama(
         # Part of the attention math, not the weights: the export would
         # load cleanly and silently produce different logits.
         unexportable.append("attn_logit_softcap")
+    if cfg.post_norms:
+        # Extra weights with no slot: they would silently vanish.
+        unexportable.append("post_norms weights")
+    for knob in ("final_logit_softcap", "query_scale",
+                 "sliding_window_pattern"):
+        if getattr(cfg, knob) is not None:
+            unexportable.append(knob)
+    if cfg.embed_scale or cfg.norm_scale_plus_one:
+        # Math the Llama schema does not encode: loads cleanly, computes
+        # differently.
+        unexportable.append("embed_scale/norm_scale_plus_one semantics")
     if unexportable:
         raise ValueError(
             "model has no slot in the Llama state-dict schema for: "
@@ -294,6 +305,9 @@ def from_hf_gemma2(sd: Mapping[str, np.ndarray], cfg: ModelConfig) -> Params:
         "final_norm": {"scale": np.asarray(sd["model.norm.weight"])},
         "blocks": _stack(cfg, blocks),
     }
+    # Raises if the checkpoint carries an untied lm_head this tied config
+    # would silently ignore (same guard as the Llama importer).
+    _maybe_lm_head(sd, cfg, params, "model.embed_tokens.weight")
     return _cast(cfg, params)
 
 

@@ -52,6 +52,46 @@ def test_engine_matches_full_forward(preset):
     assert out == ref
 
 
+def test_gemma2_engine_pallas_matches_xla_beyond_window():
+    """Gemma-2 serving on the Pallas path (flash prefill + ragged paged
+    decode with PER-LAYER windows through the grouped layer scan,
+    interpret mode) must produce the xla path's tokens — generating PAST
+    the sliding window, the hard case for the paged kernel's window/page
+    clamp when full-context pages are kept for the global layers."""
+    import dataclasses
+
+    cfg, params = _setup("tiny-gemma2")
+    prompt = [5, 3, 9, 250, 17]
+    n = 24                                  # context 29 >> window 16
+    ref = InferenceEngine(cfg, params).generate([prompt], n)[0]
+    pcfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, kernels="pallas_interpret")
+    )
+    out = InferenceEngine(pcfg, params).generate([prompt], n)[0]
+    assert out == ref
+
+
+def test_gemma2_engine_softcap_regime():
+    """Serving must apply the attention logit softcap (regression: prefill
+    and the xla decode fallback silently omitted it). Tiny random weights
+    never reach the cap, so scale the q/k projections until logits live in
+    the tanh-saturating regime — engine tokens must still equal the
+    training forward's."""
+    import jax.numpy as jnp
+
+    cfg, params = _setup("tiny-gemma2")
+    boost = jnp.asarray(6.0, params["blocks"]["attn"]["wq"].dtype)
+    params = dict(params)
+    params["blocks"] = jax.tree.map(lambda x: x, params["blocks"])
+    params["blocks"]["attn"] = dict(params["blocks"]["attn"])
+    params["blocks"]["attn"]["wq"] = params["blocks"]["attn"]["wq"] * boost
+    params["blocks"]["attn"]["wk"] = params["blocks"]["attn"]["wk"] * boost
+    prompt = [5, 3, 9, 250, 17]
+    ref = _ref_generate(params, cfg.model, prompt, 8)
+    out = InferenceEngine(cfg, params).generate([prompt], 8)[0]
+    assert out == ref
+
+
 def test_gemma2_engine_beyond_window():
     """Gemma-2 serving past the sliding window: local layers mask to the
     last W positions while global layers read the whole history (pages

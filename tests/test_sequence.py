@@ -337,6 +337,33 @@ def test_sp_window_rejects_non_causal(cpu_devices):
                            window=8)
 
 
+def test_trainer_gemma2_sp_equivalence(cpu_devices):
+    """Gemma-2's interleaved local/global layers under sequence
+    parallelism: per-layer windows thread into the ring (local layers get
+    O(window) truncated rings, global layers full rings) and the sp=2
+    trajectory matches single-device."""
+    from orion_tpu.config import get_config
+    from orion_tpu.train import Trainer
+
+    def run(axes):
+        overrides = [
+            "runtime.platform=cpu", "data.batch_size=4", "data.seq_len=64",
+            "train.num_steps=2", "train.log_interval=100",
+            "optimizer.warmup_steps=1",
+        ] + [f"parallel.{k}={v}" for k, v in axes.items()]
+        t = Trainer(get_config("tiny-gemma2", overrides))
+        state, _ = t.restore_or_init()
+        losses = []
+        for step in range(2):
+            state, m = t.train_step(state, t.global_batch(step))
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    base = run({})
+    sp = run({"sp": 2})
+    np.testing.assert_allclose(sp, base, rtol=2e-4)
+
+
 def test_trainer_swa_sp_equivalence(cpu_devices):
     """A sliding-window (Mistral-family) model trains under sp>1 and
     reproduces the single-device trajectory — the combination the
