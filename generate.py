@@ -34,6 +34,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--eos-id", type=int, default=None)
     parser.add_argument("--stream", action="store_true",
                         help="print tokens incrementally as they decode")
+    parser.add_argument("--temperature", type=float, default=None,
+                        help="sampling temperature (0 = greedy); sugar for "
+                             "inference.temperature")
+    parser.add_argument("--top-k", type=int, default=None,
+                        help="top-k sampling filter (0 disables)")
+    parser.add_argument("--top-p", type=float, default=None,
+                        help="nucleus sampling threshold in (0, 1]")
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides"
     )
@@ -47,7 +54,21 @@ def main(argv: list[str] | None = None) -> int:
     from orion_tpu.models import init_params
     from orion_tpu.runtime import initialize
 
-    cfg = get_config(args.preset, args.overrides)
+    # Same contract as engine.submit's per-request validation — the CLI
+    # must not smuggle out-of-range values in through config overrides.
+    if args.temperature is not None and args.temperature < 0.0:
+        raise SystemExit(f"--temperature must be >= 0, got {args.temperature}")
+    if args.top_k is not None and args.top_k < 0:
+        raise SystemExit(f"--top-k must be >= 0, got {args.top_k}")
+    if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+        raise SystemExit(f"--top-p must be in (0, 1], got {args.top_p}")
+    overrides = list(args.overrides)
+    for flag, key in ((args.temperature, "inference.temperature"),
+                      (args.top_k, "inference.top_k"),
+                      (args.top_p, "inference.top_p")):
+        if flag is not None:
+            overrides.append(f"{key}={flag}")
+    cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 
     prompts: list[list[int]] = []
