@@ -177,27 +177,16 @@ def _probe_device(timeout_s: float = 180.0) -> bool:
 
     The TPU plugin can hang indefinitely inside backend init when its
     tunnel is down (observed repeatedly on the dev box); probing in a
-    subprocess with a timeout turns that hang into a clean, fast JSON
-    error line the driver can record.
+    subprocess with a timeout (orion_tpu.runtime.probe — shared with
+    tools/tunnel_window.py) turns that hang into a clean, fast JSON error
+    line the driver can record.
     """
-    import subprocess
+    from orion_tpu.runtime.probe import probe_device
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        _probe_error(
-            f"accelerator backend unresponsive after {timeout_s}s "
-            "(device tunnel down?)"
-        )
-        return False
-    if r.returncode != 0:
-        _probe_error("backend init failed: " + r.stderr.strip()[-400:])
-        return False
-    return True
+    alive, detail = probe_device(timeout_s)
+    if not alive:
+        _probe_error(detail)
+    return alive
 
 
 def _probe_error(msg: str) -> None:

@@ -1,0 +1,33 @@
+"""Accelerator liveness probe (single definition; bench.py and
+tools/tunnel_window.py both use it).
+
+The dev chip's TPU plugin can hang indefinitely inside backend init when
+its tunnel is down — or fail fast with UNAVAILABLE — so the probe runs
+``jax.devices()`` in a SUBPROCESS under a timeout and reports a boolean
+plus the failure detail.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+DEFAULT_TIMEOUT_S = 180.0
+
+
+def probe_device(timeout_s: float = DEFAULT_TIMEOUT_S) -> tuple[bool, str]:
+    """(alive, detail). detail is '' when alive, else the failure reason."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"accelerator backend unresponsive after {timeout_s}s "
+            "(device tunnel down?)"
+        )
+    if r.returncode != 0:
+        return False, "backend init failed: " + r.stderr.strip()[-400:]
+    return True, ""

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Exploit a TPU-tunnel window: run every queued on-chip check, in priority
+order, each under its own timeout, appending results to TUNNEL_RUNS.jsonl.
+
+The dev chip's tunnel dies for hours (see orion_tpu.runtime.probe); when it
+comes back — possibly briefly — the highest-value runs must happen first
+and every result must be captured durably. One command does it all:
+
+    python tools/tunnel_window.py            # probe, then run the queue
+    python tools/tunnel_window.py --list     # show the queue
+
+Paths are anchored to the repo root (runnable from anywhere); the tunnel is
+re-probed after EVERY tool so a mid-queue drop stops the run before the
+next tool burns its whole budget hanging; the exit code is the worst rc
+seen, so wrappers can tell an all-green window from a window of failures.
+
+Priority order (VERDICT r3 items 1-4):
+  1. bench.py                  — the judged metric (train MFU + serving)
+  2. tools/tpu_parity.py       — Mosaic-compiled kernel parity (33 checks)
+  3. tools/scan_probe.py       — scan_unroll x grad_dtype MFU probes
+  4. tools/moe_dispatch_bench.py
+  5. tools/longcontext_bench.py
+  6. tools/prefill_burst_bench.py
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from orion_tpu.runtime.probe import probe_device  # noqa: E402
+
+QUEUE = [
+    ("bench", [sys.executable, str(ROOT / "bench.py")], 3600),
+    ("tpu_parity", [sys.executable, str(ROOT / "tools/tpu_parity.py")], 2700),
+    ("scan_probe", [sys.executable, str(ROOT / "tools/scan_probe.py")], 5400),
+    ("moe_dispatch",
+     [sys.executable, str(ROOT / "tools/moe_dispatch_bench.py")], 1800),
+    ("longcontext",
+     [sys.executable, str(ROOT / "tools/longcontext_bench.py")], 2700),
+    ("prefill_burst",
+     [sys.executable, str(ROOT / "tools/prefill_burst_bench.py")], 1800),
+]
+
+LOG = ROOT / "TUNNEL_RUNS.jsonl"
+
+
+def _text(x) -> str:
+    if isinstance(x, bytes):
+        return x.decode(errors="replace")
+    return x or ""
+
+
+def main() -> int:
+    if "--list" in sys.argv[1:]:
+        for name, args, budget in QUEUE:
+            print(f"{name:>14}  budget={budget}s  {' '.join(args[1:])}")
+        return 0
+    alive, detail = probe_device(120)
+    if not alive:
+        print(f"tunnel DOWN ({detail}); nothing run")
+        return 1
+    print("tunnel UP — running the queue")
+    worst = 0
+    for name, args, budget in QUEUE:
+        stamp = datetime.datetime.utcnow().isoformat() + "Z"
+        try:
+            r = subprocess.run(args, capture_output=True, text=True,
+                               timeout=budget, cwd=str(ROOT))
+            rec = {"tool": name, "at": stamp, "rc": r.returncode,
+                   "stdout": r.stdout[-8000:], "stderr": r.stderr[-1000:]}
+            worst = max(worst, abs(r.returncode))
+        except subprocess.TimeoutExpired as e:
+            rec = {"tool": name, "at": stamp, "rc": "TIMEOUT",
+                   "budget_s": budget,
+                   "stdout": _text(e.stdout)[-8000:],
+                   "stderr": _text(e.stderr)[-1000:]}
+            worst = max(worst, 1)
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"{name}: rc={rec['rc']} (logged to {LOG})", flush=True)
+        # Re-probe after EVERY tool (seconds while up): a mid-queue drop
+        # must stop the run before the next tool hangs through its budget.
+        alive, detail = probe_device(120)
+        if not alive:
+            print(f"tunnel dropped mid-queue ({detail}); stopping")
+            return max(worst, 1)
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())
