@@ -17,6 +17,8 @@ S in {8k, 16k, 32k}:
 
 Output: one JSON line per (S, measurement).
 """
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
 import sys
 import time

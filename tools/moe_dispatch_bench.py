@@ -12,6 +12,8 @@ measured dispatch share. Runs on the real TPU by default:
 
 Output: one JSON line per mode + a summary line with the dispatch share.
 """
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
 import sys
 import time

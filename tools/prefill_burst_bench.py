@@ -12,6 +12,8 @@ plus the padded-blocks compute, which grows with length spread.
 
 Output: one JSON line per burst shape.
 """
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
 import sys
 import time
@@ -75,6 +77,11 @@ def main() -> int:
             "burst": name,
             "lengths": lengths,
             "admit_ms": round(dt * 1e3, 2),
+            # Round 5 split the prefill dispatch->first-token span out of
+            # host_s into its own bucket: for an admit step prefill_ms IS
+            # the burst cost this bench measures; host_ms is scheduler
+            # overhead only.
+            "prefill_ms": round(t["prefill_s"] * 1e3, 2),
             "device_ms": round(t["device_s"] * 1e3, 2),
             "host_ms": round(t["host_s"] * 1e3, 2),
             "tokens": int(sum(lengths)),

@@ -13,6 +13,9 @@ Usage:
 
 from __future__ import annotations
 
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
+
 import collections
 import glob
 import gzip

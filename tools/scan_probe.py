@@ -16,6 +16,8 @@ Candidates: scan_unroll x {1, 2, 4}, train.grad_dtype=bfloat16, and the
 combination. Output: one JSON line per candidate (MFU + step time, or the
 timeout/error), then a summary naming the winner.
 """
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
 import subprocess
 import sys

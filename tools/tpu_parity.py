@@ -26,6 +26,8 @@ matched the reference within bf16 tolerance.
 whatever backend is default (CI self-test of this script's own logic; it does
 NOT validate Mosaic compilation).
 """
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import sys
 
 INTERP = False  # set by --interpret; default is compiled-on-TPU

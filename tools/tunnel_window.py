@@ -65,12 +65,19 @@ def main() -> int:
         print(f"tunnel DOWN ({detail}); nothing run")
         return 1
     print("tunnel UP — running the queue")
+    # Tools under tools/ get sys.path[0] = tools/ when run as scripts;
+    # export the repo root so `import orion_tpu` works in every child
+    # (round-5 fix: the first compiled tpu_parity run died on this).
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
     worst = 0
     for name, args, budget in QUEUE:
         stamp = datetime.datetime.utcnow().isoformat() + "Z"
         try:
             r = subprocess.run(args, capture_output=True, text=True,
-                               timeout=budget, cwd=str(ROOT))
+                               timeout=budget, cwd=str(ROOT), env=env)
             rec = {"tool": name, "at": stamp, "rc": r.returncode,
                    "stdout": r.stdout[-8000:], "stderr": r.stderr[-1000:]}
             worst = max(worst, abs(r.returncode))
