@@ -250,7 +250,11 @@ def _dq_kernel(st: _Statics, has_seg, *refs):
         z, t = _scaled_logits(st, q, k, scale)
         mask = _block_mask(st, iq, ik, qseg, kseg, qpos, kpos)
         lse = lse_ref[0, 0][:, :1]                # [bq, 1] (lanes-broadcast)
-        p = jnp.exp(z - lse) * mask.astype(jnp.float32)
+        # Mask INSIDE the exp (as the forward does): a fully-masked q row
+        # carries the finite NEG_INF lse stand-in, so exp(z - lse) on its
+        # raw logits overflows to inf and inf * 0-mask is NaN (hit by the
+        # round-5 compiled ring-merge parity check).
+        p = jnp.exp(jnp.where(mask, z - lse, NEG_INF))
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -293,7 +297,8 @@ def _dkv_kernel(st: _Statics, has_seg, *refs):
         z, t = _scaled_logits(st, q, k, scale)
         mask = _block_mask(st, iq, ik, qseg, kseg, qpos, kpos)
         lse = lse_ref[0, 0][:, :1]
-        p = jnp.exp(z - lse) * mask.astype(jnp.float32)
+        # Masked inside the exp — see _dq_kernel for the NaN rationale.
+        p = jnp.exp(jnp.where(mask, z - lse, NEG_INF))
         dv_s[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -573,6 +578,16 @@ def _prep(
 
     bq = min(block_q or 1024, round_up(Sq, 8))
     bk = min(block_kv or 1024, round_up(Skv, 8))
+    if q_segment_ids is not None or q_positions is not None:
+        # Segment/position refs are full-length (B, 1, S) int32 arrays that
+        # the kernel slices at dynamic lane offsets (i * block). Mosaic
+        # requires dynamic lane slices to be provably 128-aligned, so the
+        # blocks (and hence every offset, a multiple of the block) must be
+        # multiples of the 128-lane tile — the round-5 compiled run died
+        # on a 64-wide i32 load here. Padded q rows slice off at the end;
+        # padded kv columns stay masked (seg 0 / PAD_POS_KV conventions).
+        bq = round_up(bq, 128)
+        bk = round_up(bk, 128)
     Sq_p, Skv_p = round_up(Sq, bq), round_up(Skv, bk)
 
     st = _Statics(

@@ -111,29 +111,38 @@ def _kernel(
         # on every revisit: the revisits re-copy the STALE input block
         # (fetched before any write-back), so a single insert at the owning
         # grid step would be clobbered by the tail's final write-back.
-        ko_ref[...] = k_ref[...]
-        vo_ref[...] = v_ref[...]
-        if quant:
-            kso_ref[...] = ks_ref[...]
-            vso_ref[...] = vs_ref[...]
-
-        @pl.when(ip >= last_pos // psz)
-        def _write():
-            off = last_pos % psz
-            if not quant:
-                ko_ref[0, :, pl.ds(off, 1), :] = kn_ref[0][:, None, :]
-                vo_ref[0, :, pl.ds(off, 1), :] = vn_ref[0][:, None, :]
-                return
+        # The insert is a MASKED full-block merge, not a dynamic-index row
+        # store: Mosaic rejects vector stores at runtime-computed sublane /
+        # lane offsets ("cannot statically prove the index is a multiple of
+        # the tile"), which the round-5 compiled run hit; a select against a
+        # sublane iota stores the whole (tiling-legal) block instead.
+        off = last_pos % psz
+        insert = ip >= last_pos // psz
+        row = lax.broadcasted_iota(jnp.int32, (K, psz, 1), 1)
+        sel = insert & (row == off)                       # [K, psz, 1]
+        if not quant:
+            ko_ref[0] = jnp.where(
+                sel, kn_ref[0][:, None, :].astype(ko_ref.dtype), k_ref[0]
+            )
+            vo_ref[0] = jnp.where(
+                sel, vn_ref[0][:, None, :].astype(vo_ref.dtype), v_ref[0]
+            )
+        else:
             # Quantize the new token's K/V in-kernel via the SAME function
             # the jnp prefill path uses (common.quantize_kv) — decode and
-            # prefill quantization agree bit-for-bit by construction.
-            for new_ref, out_ref, s_ref in (
-                (kn_ref, ko_ref, kso_ref), (vn_ref, vo_ref, vso_ref),
+            # prefill quantization agree bit-for-bit by construction. The
+            # scale pools merge the same way against a lane iota.
+            col = lax.broadcasted_iota(jnp.int32, ks_ref[0].shape, 1)
+            scol = insert & (col == off)                  # [K, SCALE_LANES]
+            for new_ref, in_ref, out_ref, sin_ref, sout_ref in (
+                (kn_ref, k_ref, ko_ref, ks_ref, kso_ref),
+                (vn_ref, v_ref, vo_ref, vs_ref, vso_ref),
             ):
                 qv, s = quantize_kv(new_ref[0])             # [K, H], [K]
-                out_ref[0, :, pl.ds(off, 1), :] = qv.astype(
-                    out_ref.dtype)[:, None, :]
-                s_ref[0, :, pl.ds(off, 1)] = s[:, None]
+                out_ref[0] = jnp.where(
+                    sel, qv.astype(out_ref.dtype)[:, None, :], in_ref[0]
+                )
+                sout_ref[0] = jnp.where(scol, s[:, None], sin_ref[0])
 
         k_src, v_src = ko_ref, vo_ref
         ks_src, vs_src = kso_ref, vso_ref
@@ -319,6 +328,8 @@ def paged_attention(
     interpret: Optional[bool] = None,
     k_scale: Optional[jax.Array] = None,    # [rows, K, SCALE_LANES] f32:
     v_scale: Optional[jax.Array] = None,    #   int8-pool per-token scales
+    mesh: Optional[jax.sharding.Mesh] = None,
+    tp_axis: str = "tp",
 ):
     """Decode attention over the paged KV pool.
 
@@ -348,6 +359,71 @@ def paged_attention(
     K = k_pool.shape[1]
     assert q.shape[1] % K == 0, (q.shape, K)
     base = jnp.asarray(layer_base, jnp.int32).reshape(1)
+
+    tp = mesh.shape.get(tp_axis, 1) if mesh is not None else 1
+    if tp > 1:
+        # Tensor-parallel serving: split the HEAD axes (q heads, pool kv
+        # heads, new-token kv heads, scale-pool kv heads) across ``tp_axis``
+        # and run the kernel per shard — a bare pallas_call is opaque to
+        # XLA's partitioner, so jitting it over a tp-sharded pool would
+        # gather the whole multi-GB pool onto every device. The page walk
+        # is head-independent (page_table/last_pos/base replicate), and the
+        # fused in-place write stays consistent per shard: each device
+        # owns its K/tp slice of every page. G = N/K is preserved per
+        # shard, so the in-kernel GQA mapping is unchanged.
+        N = q.shape[1]
+        if N % tp or K % tp:
+            raise ValueError(
+                f"tp-sharded paged attention needs n_heads ({N}) and "
+                f"n_kv_heads ({K}) divisible by {tp_axis}={tp}; lower tp "
+                f"or serve with kernels='xla'"
+            )
+        from jax.sharding import PartitionSpec as P
+
+        qspec = P(None, tp_axis, None)          # [B, N, H]
+        poolspec = P(None, tp_axis, None, None)  # [rows, K, psz, H]
+        rep2, rep1 = P(None, None), P(None)
+        args = [q, k_pool, v_pool, page_table, last_pos, base]
+        in_specs = [qspec, poolspec, poolspec, rep2, rep1, rep1]
+        out_specs = [qspec]
+        have_new, have_scale = k_new is not None, k_scale is not None
+        if have_new:
+            args += [k_new, v_new]
+            in_specs += [qspec, qspec]           # [B, K, H]
+            out_specs += [poolspec, poolspec]
+        if have_scale:
+            scspec = P(None, tp_axis, None)      # [rows, K, SCALE_LANES]
+            args += [k_scale, v_scale]
+            in_specs += [scspec, scspec]
+            if have_new:
+                out_specs += [scspec, scspec]
+
+        def body(q_, kp_, vp_, pt_, lp_, base_, *rest):
+            kn = vn = ks = vs = None
+            rest = list(rest)
+            if have_new:
+                kn, vn = rest[0], rest[1]
+                rest = rest[2:]
+            if have_scale:
+                ks, vs = rest[0], rest[1]
+            res = _call(
+                q_, kp_, vp_, pt_, lp_, base_, kn, vn,
+                logit_softcap, window, interpret, ks, vs,
+            )
+            if not have_new:
+                return res[0]
+            return res[:3] if not have_scale else res
+
+        mapped = jax.shard_map(
+            body, mesh=mesh, in_specs=tuple(in_specs),
+            out_specs=tuple(out_specs) if have_new else out_specs[0],
+            check_vma=False,
+        )
+        out = mapped(*args)
+        if not have_new:
+            return out
+        return tuple(out)
+
     out = _call(
         q, k_pool, v_pool, page_table, last_pos, base, k_new, v_new,
         logit_softcap, window, interpret, k_scale, v_scale,
