@@ -119,9 +119,13 @@ class ModelConfig:
 
     # Pipeline parallelism: when pipeline_axis names a mesh axis of size > 1
     # (the trainer sets this from ParallelConfig.pp), the layer stack runs as
-    # a GPipe pipeline with this many microbatches.
+    # a GPipe pipeline with this many microbatches. "interleaved" runs the
+    # virtual-stage schedule (pp_virtual_stages chunks per device, M <= pp)
+    # — see parallel/pipeline.py for the bubble math.
     pipeline_axis: Optional[str] = None
     pp_microbatches: int = 1
+    pp_schedule: str = "gpipe"        # "gpipe" | "interleaved"
+    pp_virtual_stages: int = 1
 
     # Gradient checkpointing policy for the layer scan:
     # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
@@ -132,6 +136,13 @@ class ModelConfig:
     # [B, S, V] float32 logits. None => dense loss. Cuts the peak activation
     # by ~2x(S/chunk) GiB-scale at large vocab; backward remats per chunk.
     loss_chunk: Optional[int] = None
+
+    # Device-side debug assertions inside manual shard_map regions (the
+    # sorted_a2a MoE dispatch and the ring bodies) where runtime.checkify
+    # cannot reach: OOB routing/position indices raise host-side instead
+    # of surfacing as NaNs or silent drops. Adds a per-assert callback;
+    # off in production. (SURVEY.md §6 sanitizers; runtime/asserts.py.)
+    debug_asserts: bool = False
 
     # Layers are evaluated with lax.scan over stacked per-layer params.
     scan_layers: bool = True
@@ -258,6 +269,10 @@ class ParallelConfig:
     sequence_method: str = "ring"
     # Pipeline microbatches (pp > 1). Must divide the per-step batch.
     pp_microbatches: int = 1
+    # Pipeline schedule: "gpipe" | "interleaved" (virtual stages; bubble
+    # amortized by pp_virtual_stages instead of microbatch count).
+    pp_schedule: str = "gpipe"
+    pp_virtual_stages: int = 1
     # Mesh axes that live on DCN (multi-slice); all others ride ICI.
     dcn_axes: Tuple[str, ...] = ()
 
@@ -376,6 +391,17 @@ class InferenceConfig:
     # window). Larger windows amortize host round-trips — tens of ms on a
     # tunneled chip — at the cost of decoding past EOS by up to W-1 tokens.
     decode_window: int = 8
+    # Auto-tune the window from the engine's measured device/host timing
+    # split: whenever the rolling host share of a step exceeds
+    # decode_host_share_target, the window doubles (up to
+    # decode_window_max). Growth-only: the wasted-decode cost of a large
+    # window is bounded and observable (timing['wasted_steps']), while a
+    # host-bound engine wastes wall-clock every single step. Page
+    # provisioning and the submit() pool check are sized against
+    # decode_window_max so growth never strands an admitted request.
+    decode_window_autotune: bool = False
+    decode_window_max: int = 64
+    decode_host_share_target: float = 0.25
     # KV-cache quantization: None (pool in model dtype) or "int8" (pool in
     # int8 with per-token per-kv-head f32 scales stored alongside;
     # dequantization happens inside the paged kernel / at the xla gather).

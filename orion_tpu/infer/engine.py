@@ -30,6 +30,25 @@ from orion_tpu.infer.sampling import sample
 log = logging.getLogger("orion_tpu.infer")
 
 
+def _detect_tp_mesh(params: Any, axis: str = "tp"):
+    """The params' mesh, iff they are sharded over a ``tp`` axis of size > 1.
+
+    The engine is mesh-agnostic for the dense math (XLA partitions the
+    einsums from the params' shardings alone), but the Pallas kernels are
+    opaque to the SPMD partitioner and need an explicit head-sharded
+    shard_map — which needs the mesh. Detecting it from the params keeps
+    the public engine API unchanged: shard the params, get sharded serving.
+    """
+    for leaf in jax.tree.leaves(params):
+        s = getattr(leaf, "sharding", None)
+        if (
+            isinstance(s, jax.sharding.NamedSharding)
+            and s.mesh.shape.get(axis, 1) > 1
+        ):
+            return s.mesh
+    return None
+
+
 @dataclass
 class Request:
     rid: int
@@ -97,6 +116,39 @@ class InferenceEngine:
             )
 
         self.cache = init_cache(self.mcfg, self.icfg)
+        # Tensor-parallel serving on the Pallas path: the kernels run under
+        # head-sharded shard_maps (see runner/ops), and the KV pool lives
+        # sharded over kv heads — each device holds K/tp of every page, so
+        # pool memory scales down with tp like the params do.
+        from orion_tpu.ops._dispatch import resolve_impl
+
+        self.mesh = (
+            _detect_tp_mesh(self.params)
+            if resolve_impl(self.mcfg.kernels)[0] else None
+        )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            tp = self.mesh.shape["tp"]
+            if self.mcfg.n_heads % tp or self.mcfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"Pallas serving with tp={tp} needs n_heads "
+                    f"({self.mcfg.n_heads}) and n_kv_heads "
+                    f"({self.mcfg.n_kv_heads}) divisible by it; lower tp "
+                    f"or set model.kernels='xla'"
+                )
+            spec = {
+                "k": P(None, "tp", None, None),
+                "v": P(None, "tp", None, None),
+                "k_scale": P(None, "tp", None),
+                "v_scale": P(None, "tp", None),
+            }
+            self.cache = {
+                name: jax.device_put(
+                    arr, NamedSharding(self.mesh, spec[name])
+                )
+                for name, arr in self.cache.items()
+            }
         self.alloc = PageAllocator(self.icfg.num_pages)
         self.page_table = np.zeros(
             (self.max_batch, self.pages_per_seq), np.int32
@@ -119,10 +171,21 @@ class InferenceEngine:
             self.mcfg.sliding_window
             if self.mcfg.sliding_window_pattern is None else None
         )
+        # Decode window: mutable engine state (inference.decode_window is
+        # only the starting point when auto-tune is on). Page provisioning
+        # and admission always budget for _provision_window, so growth can
+        # never strand an already-admitted request.
+        self.decode_window = self.icfg.decode_window
+        if self.icfg.decode_window_autotune and (
+            self.icfg.decode_window_max < self.icfg.decode_window
+        ):
+            raise ValueError(
+                f"decode_window_max={self.icfg.decode_window_max} < "
+                f"decode_window={self.icfg.decode_window}"
+            )
         self._dev_span = 0.0
-        self.timing = {
-            "device_s": 0.0, "host_s": 0.0, "windows": 0, "steps": 0,
-        }
+        self._prefill_span = 0.0
+        self.timing = self._zero_timing()
 
         # Per-slot sampling params (inference.* defaults; submit() can
         # override per request, vLLM-style).
@@ -136,6 +199,7 @@ class InferenceEngine:
                 decode_window,
                 cfg=self.mcfg,
                 max_seq_len=self.icfg.max_seq_len,
+                mesh=self.mesh,
             ),
             donate_argnums=(1,),
         )
@@ -148,6 +212,7 @@ class InferenceEngine:
                 decode_window,
                 cfg=self.mcfg,
                 max_seq_len=self.icfg.max_seq_len,
+                mesh=self.mesh,
                 temperature=self.icfg.temperature,
                 top_k=self.icfg.top_k,
                 top_p=self.icfg.top_p,
@@ -160,7 +225,8 @@ class InferenceEngine:
         # dispatch and rounds the batch up to a power of two to bound the
         # number of specializations.
         self._prefill = jax.jit(
-            partial(prefill_step, cfg=self.mcfg), donate_argnums=(1,)
+            partial(prefill_step, cfg=self.mcfg, mesh=self.mesh),
+            donate_argnums=(1,),
         )
 
     # -- public API --------------------------------------------------------
@@ -245,36 +311,76 @@ class InferenceEngine:
 
     def step(self) -> list[Request]:
         """Admit + prefill new requests, then run one decode WINDOW
-        (inference.decode_window fused token steps, one host round-trip)
+        (``self.decode_window`` fused token steps, one host round-trip)
         for all active slots; returns the requests that finished.
 
         Each step's wall time is split into ``timing`` (see reset_timing):
-        the device span (decode dispatch through the [W, B] token fetch)
-        vs everything else (admission, prefill, page bookkeeping, the
-        token loop) — the observability needed to tune
-        ``inference.decode_window`` from data rather than assertion.
+        the decode device span (dispatch through the [W, B] token fetch),
+        the prefill span (admission-burst dispatch through the first-token
+        fetch — its own bucket, so host_share stays meaningful on churny
+        workloads), and the host remainder — the observability needed to
+        tune the decode window from data rather than assertion.
         """
         t0 = time.perf_counter()
         self._dev_span = 0.0
+        self._prefill_span = 0.0
         self._admit()
         decoded = self._decode_all()
         total = time.perf_counter() - t0
         self.timing["device_s"] += self._dev_span
-        self.timing["host_s"] += total - self._dev_span
+        self.timing["prefill_s"] += self._prefill_span
+        self.timing["host_s"] += total - self._dev_span - self._prefill_span
         self.timing["steps"] += 1
         if decoded:
             self.timing["windows"] += 1
+            if self.icfg.decode_window_autotune:
+                self._autotune_window(total)
+        if self.mcfg.debug_asserts:
+            from orion_tpu.runtime.asserts import raise_if_failed
+
+            # The token fetch synced the device work, but not the async
+            # callback thread — the barrier orders it before the check.
+            jax.effects_barrier()
+            raise_if_failed()
         done, self._just_finished = self._just_finished, []
         return done
 
+    @staticmethod
+    def _zero_timing() -> dict:
+        return {
+            "device_s": 0.0, "host_s": 0.0, "prefill_s": 0.0,
+            "windows": 0, "steps": 0,
+            # Decode-waste accounting: slot_steps counts (active slot x
+            # inner decode step) work the device performed; wasted_steps
+            # the share discarded because the slot finished mid-window.
+            "slot_steps": 0, "wasted_steps": 0,
+        }
+
     def reset_timing(self) -> dict:
         """Return and zero the accumulated step timing split: device_s
-        (decode dispatch -> token fetch), host_s (scheduler remainder),
-        windows (steps that ran a decode window), steps (all steps)."""
-        out, self.timing = self.timing, {
-            "device_s": 0.0, "host_s": 0.0, "windows": 0, "steps": 0,
-        }
+        (decode dispatch -> token fetch), prefill_s (admission bursts),
+        host_s (scheduler remainder), windows/steps counters, and the
+        slot_steps/wasted_steps decode-waste tally."""
+        out, self.timing = self.timing, self._zero_timing()
         return out
+
+    def _autotune_window(self, step_total: float) -> None:
+        """Double the decode window while the per-step host share exceeds
+        the target (growth-only; see InferenceConfig.decode_window_autotune).
+        Uses the step's own measured split, so one slow host pass (e.g. a
+        compile) can trigger at most one doubling."""
+        host = step_total - self._dev_span - self._prefill_span
+        denom = step_total if step_total > 0 else 1.0
+        if (
+            host / denom > self.icfg.decode_host_share_target
+            and self.decode_window * 2 <= self.icfg.decode_window_max
+        ):
+            self.decode_window *= 2
+            log.info(
+                "decode_window autotune: host share %.2f > %.2f, window -> %d",
+                host / denom, self.icfg.decode_host_share_target,
+                self.decode_window,
+            )
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(
@@ -348,7 +454,7 @@ class InferenceEngine:
         first_live = self._first_live_page(context_len)
         n_real = n_pages - first_live
         last = min(
-            context_len + self.icfg.decode_window - 1,
+            context_len + self._provision_window - 1,
             self.icfg.max_seq_len - 1,
         )
         first_window = min(last // self.psz + 1, self.pages_per_seq)
@@ -367,7 +473,7 @@ class InferenceEngine:
         candidate-point check would look.
         """
         icfg = self.icfg
-        W, Wd, psz = self.page_window, icfg.decode_window, self.psz
+        W, Wd, psz = self.page_window, self._provision_window, self.psz
         ctxs = np.arange(min_ctx, max_ctx + 1, dtype=np.int64)
         chunk = icfg.prefill_chunk
         bucket = np.minimum(-(-ctxs // chunk) * chunk, icfg.max_seq_len)
@@ -381,6 +487,15 @@ class InferenceEngine:
         first_window = np.minimum(last // psz + 1, self.pages_per_seq)
         need = np.maximum(n_real + 1, first_window - first_live + 1)
         return int(need.max())
+
+    @property
+    def _provision_window(self) -> int:
+        """The decode window the pool must budget for: with auto-tune on,
+        the cap the window may grow to — admission/submit checks against
+        this, so growth never strands an admitted request."""
+        if self.icfg.decode_window_autotune:
+            return self.icfg.decode_window_max
+        return self.decode_window
 
     def _first_live_page(self, context_len: int) -> int:
         """First logical page a sequence at ``context_len`` can still read.
@@ -523,6 +638,7 @@ class InferenceEngine:
             pages[i, : len(req.pages)] = [
                 0 if p is None else p for p in req.pages
             ]
+        t0 = time.perf_counter()
         logits, self.cache = self._prefill(
             self.params,
             self.cache,
@@ -530,7 +646,8 @@ class InferenceEngine:
             jnp.asarray(lengths),
             jnp.asarray(pages),
         )
-        firsts = self._sample(logits, reqs)
+        firsts = self._sample(logits, reqs)   # blocks on the device fetch
+        self._prefill_span += time.perf_counter() - t0
         for i, req in enumerate(reqs):
             if req.max_new_tokens <= 0:
                 req.done = True   # prefill-only (scoring) request
@@ -562,7 +679,7 @@ class InferenceEngine:
         the host's view, including past mid-window EOS), preempting the
         youngest-admitted request under pool pressure (oldest requests keep
         making progress; no mid-decode crash)."""
-        W = self.icfg.decode_window
+        W = self.decode_window
         by_age = sorted(
             (r for r in self.slots if r is not None and not r.done),
             key=lambda r: r.admit_seq,
@@ -596,7 +713,7 @@ class InferenceEngine:
         if not active:
             self._reap()
             return False
-        W = self.icfg.decode_window
+        W = self.decode_window
         mask = np.array(
             [r is not None and not r.done for r in self.slots], bool
         )
@@ -625,10 +742,14 @@ class InferenceEngine:
             )
         tokens = np.asarray(jax.device_get(toks))   # [W, B], ONE fetch
         self._dev_span += time.perf_counter() - t_dev
+        self.timing["slot_steps"] += W * len(active)
         for j in range(W):
             for req in active:
                 if req.done:
-                    continue  # finished mid-window; discard overshoot
+                    # Finished mid-window: the device still decoded this
+                    # slot; the discarded overshoot is the tunable waste.
+                    self.timing["wasted_steps"] += 1
+                    continue
                 tok = int(tokens[j, req.slot])
                 self.seq_lens[req.slot] += 1
                 self.last_token[req.slot] = tok

@@ -114,8 +114,13 @@ def prefill_step(
     lengths: jax.Array,       # [Nb] int32: true prompt lengths
     pages: jax.Array,         # [Nb, S_pad // page_size] int32 page ids
     cfg: ModelConfig,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> tuple[jax.Array, Cache]:
     """Prefill a batch of same-bucket prompts in ONE dispatch.
+
+    ``mesh`` (tensor-parallel serving) makes the flash kernel run under a
+    head-sharded shard_map instead of gathering tp-sharded q/k/v; the
+    dense matmuls partition from the params' shardings as usual.
 
     Returns (next-token logits [Nb, V], updated cache). Rows are independent
     sequences (separate page sets); a burst of admissions is served by a
@@ -148,7 +153,7 @@ def prefill_step(
             logit_softcap=cfg.attn_logit_softcap,
             window=cfg.layer_window(j),
             block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
-            impl=cfg.kernels,
+            impl=cfg.kernels, mesh=mesh,
         )
         a = out_proj(out, bp["attn"], cfg)
         if cfg.post_norms:
@@ -203,6 +208,7 @@ def _decode_core(
     write_pos: jax.Array,     # [B] int32 position being written/attended
     page_table: jax.Array,    # [B, pages_per_seq] int32 (per-layer-relative)
     cfg: ModelConfig,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> tuple[jax.Array, Cache]:
     """One decode forward for every slot -> (logits [B, V], cache')."""
     B = tokens.shape[0]
@@ -251,6 +257,7 @@ def _decode_core(
                 interpret=interpret,
                 k_scale=cc.get("k_scale"),
                 v_scale=cc.get("v_scale"),
+                mesh=mesh,
             )
             if quant:
                 out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
@@ -324,6 +331,7 @@ def decode_window(
     top_p: jax.Array,         # [B] f32
     cfg: ModelConfig,
     max_seq_len: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> tuple[jax.Array, Cache]:
     """W fused decode+sample steps; returns (tokens [W, B] int32, cache).
 
@@ -341,7 +349,7 @@ def decode_window(
         tok, sl, cc = carry
         act = active & (sl < max_seq_len)
         wp = jnp.minimum(sl, max_seq_len - 1)
-        logits, cc = _decode_core(params, cc, tok, wp, page_table, cfg)
+        logits, cc = _decode_core(params, cc, tok, wp, page_table, cfg, mesh)
         toks = sample(
             logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
         )

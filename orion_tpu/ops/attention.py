@@ -143,6 +143,8 @@ def attention(
     block_kv: Optional[int] = None,
     impl: str = "xla",
     seg_pad_zero: bool = False,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    tp_axis: str = "tp",
 ) -> jax.Array:
     """Grouped-query scaled-dot-product attention. Shapes as attention_xla.
 
@@ -150,6 +152,14 @@ def attention(
     may SKIP all-padding blocks (ragged prefill / packed tails); results
     are unchanged for callers honoring the pack_rows convention, and the
     xla path ignores it (no block structure to skip).
+
+    ``mesh`` (with a ``tp_axis`` of size > 1) runs the flash kernel under a
+    ``shard_map`` that splits the HEAD axes over tensor parallelism: a bare
+    ``pallas_call`` is opaque to XLA's SPMD partitioner, so jitting it over
+    tp-sharded q/k/v would otherwise gather full-size operands onto every
+    device (serving an 8B+ model sharded, SURVEY.md §4 stack B, needs the
+    kernel to stay sharded). The xla path ignores ``mesh`` — einsums
+    partition natively from the operands' shardings.
     """
     from orion_tpu.ops._dispatch import resolve_impl
 
@@ -162,22 +172,68 @@ def attention(
             )
         from orion_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(
-            q,
-            k,
-            v,
+        kernel_kw = dict(
             causal=causal,
-            q_segment_ids=q_segment_ids,
-            kv_segment_ids=kv_segment_ids,
             logit_softcap=logit_softcap,
             q_offset=q_offset,
-            q_positions=q_positions,
-            kv_positions=kv_positions,
             window=window,
             block_q=block_q,
             block_kv=block_kv,
             interpret=interpret,
             seg_pad_zero=seg_pad_zero,
+        )
+        tp = mesh.shape.get(tp_axis, 1) if mesh is not None else 1
+        if tp > 1:
+            from jax.sharding import PartitionSpec as P
+
+            n_heads, n_kv = q.shape[2], k.shape[2]
+            if n_heads % tp or n_kv % tp:
+                raise ValueError(
+                    f"tp-sharded flash attention needs n_heads ({n_heads}) "
+                    f"and n_kv_heads ({n_kv}) divisible by {tp_axis}={tp}; "
+                    f"lower tp or use impl='xla'"
+                )
+            # Heads shard; batch/seq operands (segments, positions)
+            # replicate. Optional operands join the arg list only when
+            # present so the shard_map signature stays positional.
+            hspec = P(None, None, tp_axis, None)
+            sspec = P(None, None)  # segments are [B, S] (kernel contract)
+            opt = [
+                ("q_segment_ids", q_segment_ids, sspec),
+                ("kv_segment_ids", kv_segment_ids, sspec),
+                ("q_positions", q_positions,
+                 P(*([None] * (q_positions.ndim if q_positions is not None
+                               else 1)))),
+                ("kv_positions", kv_positions,
+                 P(*([None] * (kv_positions.ndim if kv_positions is not None
+                               else 1)))),
+            ]
+            names = [n for n, a, _ in opt if a is not None]
+            extras = [a for _, a, _ in opt if a is not None]
+            especs = [s for _, a, s in opt if a is not None]
+
+            def body(q_, k_, v_, *rest):
+                kw = dict(zip(names, rest))
+                return flash_attention(q_, k_, v_, **kernel_kw, **kw)
+
+            mapped = jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(hspec, hspec, hspec, *especs),
+                out_specs=hspec,
+                check_vma=False,
+            )
+            return mapped(q, k, v, *extras)
+
+        return flash_attention(
+            q,
+            k,
+            v,
+            q_segment_ids=q_segment_ids,
+            kv_segment_ids=kv_segment_ids,
+            q_positions=q_positions,
+            kv_positions=kv_positions,
+            **kernel_kw,
         )
     return attention_xla(
         q,
