@@ -338,6 +338,7 @@ def _attn_block(
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
+            debug_asserts=cfg.debug_asserts,
         )
     else:
         # Window distance is measured on token INDEX, which equals position
@@ -510,6 +511,8 @@ def _hidden_states(
             mesh,
             axis=cfg.pipeline_axis,
             num_microbatches=cfg.pp_microbatches,
+            schedule=cfg.pp_schedule,
+            virtual_stages=cfg.pp_virtual_stages,
         )
     elif cfg.scan_layers:
         if pattern is None:

@@ -33,6 +33,22 @@ at M=2 -> 490 at M=4 -> 435 at M=8, tracking the predicted 1.50x / 1.25x /
 1.12x compute inflation — i.e. the bubble is governed by M exactly as the
 formula says, and M is cheap to raise. Revisit only if a config appears
 where boundary-activation memory, not params, is the binding constraint.
+
+The INTERLEAVED (Megatron virtual-pipeline-class) schedule attacks the
+bubble where raising M cannot: each device owns V non-contiguous layer
+chunks (chunk c on device c mod pp), ticks advance at CHUNK granularity,
+and a microbatch laps the device ring V times. Per-batch overhead drops
+from GPipe's (M+pp-1)/M to (M+V*pp-1)/(V*M): at M=pp, V=4 that is
+~1.25x vs GPipe's ~2x — and, crucially, V raises utilization WITHOUT
+shrinking the microbatch, so it composes with small global batches where
+GPipe's only lever (more, smaller microbatches) starves the MXU.
+Scheduling constraint: M <= pp keeps at most ONE of a device's V chunks
+active per tick, which is what lets the schedule stay a uniform SPMD scan
+that ``jax.grad`` differentiates (the reverse scan IS the interleaved
+backward). Cost: the round-robin chunk layout is a one-gather-per-step
+resharding of the stage params (volume comparable to the param
+all-gather every ZeRO-3 step already pays). Select via
+``parallel.pp_schedule='interleaved'`` + ``parallel.pp_virtual_stages``.
 """
 
 from __future__ import annotations
@@ -55,13 +71,23 @@ def pipeline_forward(
     *,
     axis: str = "pp",
     num_microbatches: int = 1,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
     """Run the layer stack as a GPipe pipeline; returns (x_out, aux_sum).
 
     Requirements (validated by the trainer): L % pp == 0, B % M == 0, and
     per-sequence state like packed segment_ids must be absent (positions must
     be batch-uniform, which the default arange positions are).
+
+    ``schedule='interleaved'`` runs the virtual-stage schedule (module
+    docstring): ``virtual_stages`` chunks per device, M <= pp required.
     """
+    if schedule not in ("gpipe", "interleaved"):
+        raise ValueError(
+            f"unknown pp_schedule {schedule!r}; expected 'gpipe' or "
+            f"'interleaved'"
+        )
     pp = mesh.shape.get(axis, 1)
     if pp == 1:
         def scan_fn(c, bp):
@@ -77,6 +103,10 @@ def pipeline_forward(
     L = jax.tree.leaves(blocks)[0].shape[0]
     if L % pp:
         raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+    if schedule == "interleaved":
+        return _interleaved_pipeline(
+            x, blocks, block_fn, mesh, axis, M, virtual_stages
+        )
     mb = B // M
 
     # [L, ...] -> [pp, L/pp, ...]: contiguous stage chunks, so this reshape
@@ -132,6 +162,129 @@ def pipeline_forward(
         # per-stage aux partial sums) to every stage. Per-layer aux values
         # are batch means (e.g. the MoE balance loss), so average over the M
         # microbatches to match the single-batch scan semantics.
+        outputs = lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis
+        )
+        aux = lax.psum(aux_acc, axis) / M
+        return outputs, aux
+
+    outputs, aux = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(), P()),
+        axis_names={axis},
+    )(x_mb, staged)
+    return outputs.reshape(B, S, D), aux
+
+
+def _interleaved_pipeline(
+    x: jax.Array,
+    blocks: Any,
+    block_fn: BlockFn,
+    mesh: Mesh,
+    axis: str,
+    M: int,
+    V: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Virtual-stage (interleaved) schedule: chunk c of V*pp lives on device
+    c mod pp; tick t runs chunk s on microbatch t-s; ppermute is the full
+    ring (the wrap link carries a microbatch into its next lap). M <= pp
+    keeps exactly one of a device's V chunks active per tick, so the
+    schedule is a uniform SPMD scan and ``jax.grad`` of it IS the
+    interleaved backward. See the module docstring for the bubble math.
+    """
+    pp = mesh.shape[axis]
+    B, S, D = x.shape
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if V < 1:
+        raise ValueError(f"pp_virtual_stages={V} must be >= 1")
+    if L % (V * pp):
+        raise ValueError(
+            f"n_layers {L} not divisible by pp*pp_virtual_stages "
+            f"({pp}*{V})"
+        )
+    if M > pp:
+        raise ValueError(
+            f"interleaved schedule needs pp_microbatches ({M}) <= pp "
+            f"({pp}): a device may only have one active chunk per tick; "
+            f"raise pp_virtual_stages (not M) to amortize the bubble"
+        )
+    mb = B // M
+    Lc = L // (V * pp)
+
+    # Round-robin chunk layout: device d owns chunks {j*pp + d}. The
+    # stacked params are sharded contiguously on the layer dim, so this
+    # static gather is a per-step resharding of the stage params (cost ~
+    # one ZeRO-3 param all-gather; see module docstring).
+    perm = jnp.asarray(
+        [
+            (j * pp + d) * Lc + i
+            for d in range(pp)
+            for j in range(V)
+            for i in range(Lc)
+        ],
+        jnp.int32,
+    )
+    staged = jax.tree.map(
+        lambda a: jnp.take(a, perm, axis=0).reshape(
+            pp, V, Lc, *a.shape[1:]
+        ),
+        blocks,
+    )
+    x_mb = x.reshape(M, mb, S, D)
+
+    def local(x_mb, staged):
+        chunks = jax.tree.map(lambda a: a[0], staged)   # [V, Lc, ...]
+        stage = lax.axis_index(axis)
+        npp = lax.axis_size(axis)
+        T = M + V * npp - 1
+        ring = [(i, (i + 1) % npp) for i in range(npp)]
+        is_last = stage == npp - 1
+
+        def run_chunk(c, j):
+            cp = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
+                chunks,
+            )
+
+            def scan_fn(h, bp):
+                y, aux = block_fn(h, bp)
+                return y, aux
+
+            y, aux = lax.scan(scan_fn, c, cp)
+            return y, aux.sum()
+
+        def tick(carry, t):
+            state, outputs, aux_acc = carry
+            dt = t - stage
+            j = jnp.clip(dt // npp, 0, V - 1)       # this device's chunk lap
+            active = (dt >= 0) & (dt % npp < M) & (dt // npp < V)
+            # Chunk 0 (device 0, lap 0) injects fresh microbatches; every
+            # other (device, lap) consumes the ppermuted activation.
+            inject = x_mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where((stage == 0) & (t < M), inject, state)
+            out, aux_t = run_chunk(cur, j)
+            aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
+            # The final chunk (device pp-1, lap V-1) emits mb m at tick
+            # t = m + V*pp - 1.
+            out_idx = jnp.clip(t - (V * npp - 1), 0, M - 1)
+            emit = is_last & active & (j == V - 1)
+            outputs = outputs.at[out_idx].set(
+                jnp.where(emit, out, outputs[out_idx])
+            )
+            state = lax.ppermute(out, axis, ring)
+            return (state, outputs, aux_acc), None
+
+        carry0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis,), to="varying"),
+            (
+                jnp.zeros_like(x_mb[0]),
+                jnp.zeros_like(x_mb),
+                jnp.zeros((), jnp.float32),
+            ),
+        )
+        (_, outputs, aux_acc), _ = lax.scan(tick, carry0, jnp.arange(T))
         outputs = lax.psum(
             jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis
         )

