@@ -171,6 +171,28 @@ def route_indices(
     )[..., 0]                                                # [B, kS]
     pos = pos_km.reshape(B, k, S).transpose(0, 2, 1)         # [B, S, k]
     keep = pos < C
+    # Sanitizer hook (SURVEY.md §6): routing indices feed scatter/gather —
+    # and, on the a2a path, a cross-device all_to_all — INSIDE shard_map
+    # regions where checkify cannot reach; an OOB here otherwise surfaces
+    # as silent drops or NaNs. No-op unless model.debug_asserts.
+    from orion_tpu.runtime.asserts import device_assert
+
+    device_assert(
+        cfg.debug_asserts,
+        (idx >= 0).all() & (idx < E).all(),
+        "moe_route_idx",
+        f"router expert index outside [0, {E})",
+    )
+    # pos is a count-before-me over the [B, kS] assignment stream, so the
+    # genuine invariant is 0 <= pos < k*S (NOT pos < C, which is what
+    # ``keep`` is defined as and would be a tautology): corruption of the
+    # cumsum math or of idx skews positions outside the stream bound.
+    device_assert(
+        cfg.debug_asserts,
+        (pos >= 0).all() & (pos < k * S).all(),
+        "moe_route_pos",
+        f"capacity position outside the assignment-stream bound [0, {k * S})",
+    )
     return idx, gate, pos, keep, _aux_stats(probs, idx, cfg)
 
 

@@ -192,6 +192,7 @@ def _ring_attention_local(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     window: Optional[int] = None,
+    debug_asserts: bool = False,
 ) -> jax.Array:
     """Per-device ring attention body (runs inside shard_map).
 
@@ -231,6 +232,19 @@ def _ring_attention_local(
             iota = jnp.arange(s_loc, dtype=jnp.int32)
             qpos = idx * s_loc + iota
             kvpos = src * s_loc + iota
+            # Sanitizer hook (SURVEY.md §6): the ring's source/position
+            # arithmetic runs where checkify cannot reach; a wrong src
+            # would silently mask the wrong window. No-op unless
+            # model.debug_asserts.
+            from orion_tpu.runtime.asserts import device_assert
+
+            device_assert(
+                debug_asserts,
+                (kvpos >= 0).all() & (kvpos < sp * s_loc).all()
+                & (src >= 0) & (src < sp),
+                "ring_positions",
+                "ring step source/global positions out of range",
+            )
         blk_causal = causal and (diag or windowed)
         if use_pallas:
             from orion_tpu.ops.pallas.flash_attention import (
@@ -310,6 +324,7 @@ def _ring_striped_local(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     window: Optional[int] = None,
+    debug_asserts: bool = False,
 ) -> jax.Array:
     """Load-balanced ("zigzag-class") ring attention body.
 
@@ -370,6 +385,16 @@ def _ring_striped_local(
 
     def attend(k_, v_, seg_, src, is_first):
         kvpos = (base + src * c + off).reshape(-1)
+        # Sanitizer hook — see _ring_attention_local.block.
+        from orion_tpu.runtime.asserts import device_assert
+
+        device_assert(
+            debug_asserts,
+            (kvpos >= 0).all() & (kvpos < sp * s_loc).all()
+            & (src >= 0) & (src < sp),
+            "ring_striped_positions",
+            "striped ring step source/global positions out of range",
+        )
         if use_pallas:
             from orion_tpu.ops.pallas.flash_attention import (
                 flash_attention_with_lse,
@@ -430,6 +455,8 @@ def _ulysses_local(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     window: Optional[int] = None,
+    debug_asserts: bool = False,   # accepted for body-signature uniformity;
+    #                                ulysses has no index arithmetic to check
 ) -> jax.Array:
     """Per-device Ulysses body: a2a to full-seq / sharded-heads, attend, a2a
     back (runs inside shard_map). ``impl`` selects the local attention kernel
@@ -490,6 +517,7 @@ def sequence_attention(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     window: Optional[int] = None,
+    debug_asserts: bool = False,
 ) -> jax.Array:
     """Sequence-parallel grouped-query causal attention.
 
@@ -545,6 +573,7 @@ def sequence_attention(
     fn = partial(
         body, axis=axis, causal=causal, logit_softcap=logit_softcap, impl=impl,
         block_q=block_q, block_kv=block_kv, window=window,
+        debug_asserts=debug_asserts,
     )
     qkv_spec, seg_spec = _specs(axis, batch_axes, head_axis)
 

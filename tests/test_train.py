@@ -291,3 +291,92 @@ def test_checkify_mode_catches_oob_index():
     batch["targets"] = jax.device_put(bad, batch["targets"].sharding)
     with pytest.raises(Exception, match="(?i)out.of.bounds|index"):
         t.train_step(state, batch)
+
+
+def test_debug_asserts_injected_oob_fails_loudly_in_a2a_layout():
+    """model.debug_asserts (SURVEY.md §6; VERDICT r4 weak #7): inside the
+    sorted_a2a shard_map — where checkify cannot reach — a corrupted
+    routing index must raise host-side instead of silently dropping
+    tokens. Injection: force-fail the moe_route_idx assert site (the
+    fault-injection style of train/fault.py), proving the assert is wired
+    into THIS layout's compiled program; the same flag off must train
+    cleanly with injection armed (no-op, nothing traced)."""
+    from orion_tpu.runtime.asserts import (
+        DeviceAssertionError, clear_injected, inject,
+    )
+
+    layout = ("parallel.ep=2", "parallel.dp=2", "parallel.tp=2",
+              "model.moe_dispatch=sorted_a2a", "data.batch_size=4",
+              "data.seq_len=32", "train.num_steps=1")
+    try:
+        inject("moe_route_idx")
+        # Flag off: injection must be invisible (the assert isn't traced).
+        t = Trainer(_cfg(preset="tiny-mixtral", extra=layout))
+        state, _ = t.restore_or_init()
+        t.train_step(state, t.global_batch(0))
+
+        t = Trainer(_cfg(preset="tiny-mixtral",
+                         extra=layout + ("model.debug_asserts=true",)))
+        state, _ = t.restore_or_init()
+        with pytest.raises(DeviceAssertionError, match="moe_route_idx"):
+            out = t.train_step(state, t.global_batch(0))
+            jax.block_until_ready(out)
+    finally:
+        clear_injected()
+
+
+def test_debug_asserts_injected_oob_fails_loudly_in_sp_layout():
+    """Same contract in the ring (sp) bodies: the windowed ring's
+    source/position arithmetic asserts fire host-side under the flag."""
+    from orion_tpu.runtime.asserts import (
+        DeviceAssertionError, clear_injected, inject,
+    )
+
+    layout = ("parallel.sp=4", "parallel.dp=2", "model.sliding_window=24",
+              "data.batch_size=4", "data.seq_len=64", "train.num_steps=1")
+    try:
+        inject("ring_positions")
+        t = Trainer(_cfg(preset="tiny-llama", extra=layout))
+        state, _ = t.restore_or_init()
+        t.train_step(state, t.global_batch(0))    # flag off: clean
+
+        t = Trainer(_cfg(preset="tiny-llama",
+                         extra=layout + ("model.debug_asserts=true",)))
+        state, _ = t.restore_or_init()
+        with pytest.raises(DeviceAssertionError, match="ring_positions"):
+            out = t.train_step(state, t.global_batch(0))
+            jax.block_until_ready(out)
+    finally:
+        clear_injected()
+
+
+def test_debug_asserts_catch_true_router_corruption():
+    """A genuinely corrupted router output (monkeypatched OOB expert
+    index — the class of bug the asserts exist for) raises under the
+    flag; without it the same corruption trains 'fine' via silent-drop
+    semantics."""
+    import orion_tpu.models.moe as moe
+    from orion_tpu.runtime.asserts import DeviceAssertionError
+
+    orig = moe._router_topk
+
+    def corrupt(x, router_w, cfg):
+        probs, gate, idx = orig(x, router_w, cfg)
+        return probs, gate, idx.at[0, 0, 0].set(cfg.n_experts + 3)
+
+    layout = ("data.batch_size=4", "data.seq_len=32", "train.num_steps=1",
+              "model.moe_dispatch=sorted")
+    moe._router_topk = corrupt
+    try:
+        t = Trainer(_cfg(preset="tiny-mixtral", extra=layout))
+        state, _ = t.restore_or_init()
+        t.train_step(state, t.global_batch(0))    # silent without the flag
+
+        t = Trainer(_cfg(preset="tiny-mixtral",
+                         extra=layout + ("model.debug_asserts=true",)))
+        state, _ = t.restore_or_init()
+        with pytest.raises(DeviceAssertionError, match="moe_route_idx"):
+            out = t.train_step(state, t.global_batch(0))
+            jax.block_until_ready(out)
+    finally:
+        moe._router_topk = orig
