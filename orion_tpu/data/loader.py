@@ -18,6 +18,7 @@ with readahead lives in orion_tpu/data/native (used when available and
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Mapping
 
 import jax
@@ -26,6 +27,26 @@ import numpy as np
 from orion_tpu.config import DataConfig
 
 Batch = Mapping[str, np.ndarray]
+
+log = logging.getLogger("orion_tpu.data")
+
+# Data-stream format version. Bump whenever the (seed, step) -> batch
+# mapping changes, because the stream is otherwise SILENT about it: resume
+# replays a different token order with no error. History:
+#   1 — per-process streams (seed included process_index).
+#   2 — global (seed, step)-deterministic batch sliced per host (round 4,
+#       for elastic resume). A checkpoint written under format 1 that
+#       resumes under format 2 continues training on a DIFFERENT shuffle
+#       of the data — loss-equivalent in expectation, but not the same
+#       trajectory. Checkpoints carry no stream state (stateless resume),
+#       so this constant and the log line at loader construction are the
+#       record.
+STREAM_FORMAT = 2
+
+# Observability for pack_rows' bounded token loss (see its docstring): a
+# crossing document's carried tail is dropped at every carry-group reset.
+# Module-level tally (host-side code, single-threaded per process).
+pack_stats = {"dropped_tokens": 0}
 
 
 class Loader(abc.ABC):
@@ -41,6 +62,11 @@ class Loader(abc.ABC):
         self.process_index = process_index
         self.process_count = process_count
         self.host_batch = cfg.batch_size // process_count
+        if process_index == 0:
+            log.info("data stream format v%d (seed=%s): resuming a "
+                     "checkpoint written under an older format replays a "
+                     "different token order (see loader.STREAM_FORMAT)",
+                     STREAM_FORMAT, cfg.shuffle_seed)
 
     @abc.abstractmethod
     def batch_at(self, step: int) -> Batch:
@@ -84,6 +110,13 @@ def pack_rows(
     carry: list[np.ndarray] = []  # docs (or tails) displaced into the next row
     for b, docs in enumerate(docs_per_row):
         if carry_group is not None and b % carry_group == 0:
+            if carry:
+                # Bounded, silent-by-design token loss (docstring); tally
+                # it so the loss is observable at scale (pack_stats).
+                dropped = sum(max(len(d) - 1, 0) for d in carry)
+                pack_stats["dropped_tokens"] += dropped
+                log.debug("pack_rows: dropped %d tokens at carry-group "
+                          "boundary (row %d)", dropped, b)
             carry = []            # fixed reset boundary (see docstring)
         at, seg = 0, 0
         queue, carry = carry + list(docs), []

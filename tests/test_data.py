@@ -285,3 +285,22 @@ def test_packed_composes_with_sequence_parallelism(method):
     sp = run(["parallel.dp=2", "parallel.sp=2"])
     for a, b in zip(base, sp):
         np.testing.assert_allclose(a.loss, b.loss, rtol=2e-3, atol=2e-3)
+
+
+def test_pack_rows_drop_counter_observable():
+    """The bounded token loss at carry-group resets (ADVICE r4) is tallied
+    in loader.pack_stats so it can be monitored at scale."""
+    import numpy as np
+
+    from orion_tpu.data import loader as L
+
+    L.pack_stats["dropped_tokens"] = 0
+    long = np.arange(25, dtype=np.int32)       # 24 pairs >> seq_len
+    # Row 0 packs 10 pairs, tail (14 pairs) carries; carry_group=1 resets
+    # the carry at row 1 -> the whole tail is dropped and tallied.
+    L.pack_rows([[long], []], seq_len=10, carry_group=1)
+    assert L.pack_stats["dropped_tokens"] == 14
+    # No reset boundary crossed with the carry non-empty: nothing tallied.
+    L.pack_stats["dropped_tokens"] = 0
+    L.pack_rows([[long], []], seq_len=10, carry_group=2)
+    assert L.pack_stats["dropped_tokens"] == 0
