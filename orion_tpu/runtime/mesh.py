@@ -134,6 +134,26 @@ def _hybrid_device_array(
     for d in devs:
         groups.setdefault(d.process_index, []).append(d)
     shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+    per_process = sorted((p, len(g)) for p, g in groups.items())
+    uniform = len({n for _, n in per_process}) == 1
+    if (
+        len(groups) != n_groups
+        and len(groups) % n_groups == 0
+        and uniform
+    ):
+        # Non-trivial per-slice factor (e.g. 2 slices x 2 processes each):
+        # a CPU "slice" is a GROUP of consecutive processes, so an ICI
+        # axis can span process boundaries within a slice while the DCN
+        # axes cross slice groups — the 2-slice x 2-host factorization of
+        # a real multi-slice pod, stood in by loopback Gloo. Only merges
+        # equal-sized per-process groups: uneven contributions must fail
+        # validation below, not silently build an irregular layout.
+        k = len(groups) // n_groups
+        pids = sorted(groups)
+        groups = {
+            pids[i * k]: sum((groups[p] for p in pids[i * k:(i + 1) * k]), [])
+            for i in range(n_groups)
+        }
     if len(groups) != n_groups or any(
         len(g) != per_group for g in groups.values()
     ):
@@ -143,8 +163,8 @@ def _hybrid_device_array(
             return np.asarray(devs).reshape(shape)
         raise ValueError(
             f"dcn_axes wants {n_groups} process groups of {per_group} "
-            f"devices, but processes provide "
-            f"{sorted((p, len(g)) for p, g in groups.items())}"
+            f"devices, but processes provide {per_process} "
+            f"(per-process device counts, pre-merge)"
         )
     out = np.empty(shape, dtype=object)
     for gi, pid in enumerate(sorted(groups)):

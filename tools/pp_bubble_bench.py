@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Measure pipeline-schedule bubble on the fake 8-CPU-device mesh.
+
+The round-3 GPipe measurement (pipeline.py module docstring) showed fake-
+mesh step time tracks the predicted bubble inflation because ticks are
+compute-bound even on CPU. This tool extends it to the interleaved
+schedule: GPipe at several microbatch counts vs interleaved at several
+virtual-stage depths, pp=2 and pp=4, so the (M+pp-1)/M vs (M+V*pp-1)/(V*M)
+arithmetic in the docstring carries measured occupancy next to it.
+
+    python tools/pp_bubble_bench.py            # prints one JSON line per run
+"""
+from __future__ import annotations
+
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
+
+import json
+import os
+import time
+
+import re
+
+_f = os.environ.get("XLA_FLAGS", "")
+_m = re.search(r"host_platform_device_count=(\d+)", _f)
+if _m is None:
+    os.environ["XLA_FLAGS"] = (
+        _f + " --xla_force_host_platform_device_count=8"
+    ).strip()
+elif _m.group(1) != "8":
+    raise SystemExit(
+        f"XLA_FLAGS already pins {_m.group(0)} but this bench needs 8 "
+        f"fake CPU devices; unset XLA_FLAGS and rerun"
+    )
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def run(axes: dict, steps: int = 4) -> float:
+    from orion_tpu.config import get_config
+    from orion_tpu.train import Trainer
+
+    overrides = [
+        "runtime.platform=cpu", "data.batch_size=8", "data.seq_len=128",
+        "model.n_layers=8", "model.d_model=128", "model.d_ff=512",
+        "train.num_steps=8", "train.log_interval=1000",
+        "optimizer.warmup_steps=1",
+    ] + [f"parallel.{k}={v}" for k, v in axes.items()]
+    t = Trainer(get_config("tiny-llama", overrides))
+    state, _ = t.restore_or_init()
+    # Warm (compile) step, then timed steady-state steps.
+    state, m = t.train_step(state, t.global_batch(0))
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for s in range(1, steps + 1):
+        state, m = t.train_step(state, t.global_batch(s))
+    jax.block_until_ready(m["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
+def main() -> int:
+    base = run({})  # no-pp reference on one device's worth of layout rules
+    print(json.dumps({"layout": "plain", "ms_per_step": round(base * 1e3, 1)}))
+    for pp in (2, 4):
+        dp = 8 // pp
+        seen = set()
+        for sched, M, V in (
+            ("gpipe", 2, 1), ("gpipe", 4, 1), ("gpipe", 8, 1),
+            ("interleaved", 2, 2), ("interleaved", 2, 4),
+            ("interleaved", pp, 2), ("interleaved", pp, 4),
+        ):
+            if (sched, M, V) in seen:
+                continue
+            seen.add((sched, M, V))
+            if M > 8 or (sched == "interleaved" and M > pp):
+                continue
+            if 8 % (pp * V):
+                continue
+            ms = run({
+                "pp": pp, "dp": dp, "pp_microbatches": M,
+                "pp_schedule": sched, "pp_virtual_stages": V,
+            })
+            # Ideal occupancy models (docstring arithmetic).
+            pred = (
+                (M + pp - 1) / M if sched == "gpipe"
+                else (M + V * pp - 1) / (V * M)
+            )
+            print(json.dumps({
+                "layout": f"pp{pp}-{sched}-M{M}-V{V}",
+                "ms_per_step": round(ms * 1e3, 1),
+                "vs_plain": round(ms / base, 2),
+                "predicted_inflation": round(pred, 2),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    _sys.exit(main())
