@@ -220,6 +220,34 @@ def test_sharded_engine_pallas_matches_unsharded(kv_quant):
     assert out == ref
 
 
+def test_sharded_engine_pallas_gemma2_beyond_window():
+    """The hardest serving composition: tp-sharded params x Pallas kernels
+    x Gemma-2's interleaved per-layer windows, generating PAST the sliding
+    window — the paged kernel's window/page clamp and the flash prefill's
+    per-layer masks must hold under the head-sharded shard_map exactly as
+    unsharded."""
+    import dataclasses
+
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.models.transformer import param_logical_axes
+    from orion_tpu.parallel.sharding import param_shardings
+    from orion_tpu.runtime import build_mesh
+
+    cfg, params = _setup("tiny-gemma2")
+    pcfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, kernels="pallas_interpret")
+    )
+    prompt = [5, 3, 9, 250, 17]
+    n = 24                                   # context 29 >> window 16
+    ref = InferenceEngine(pcfg, params).generate([prompt], n)[0]
+
+    mesh = build_mesh(ParallelConfig(tp=2), devices=jax.devices("cpu")[:2])
+    shardings = param_shardings(mesh, param_logical_axes(cfg.model))
+    sharded = jax.device_put(params, shardings)
+    out = InferenceEngine(pcfg, sharded).generate([prompt], n)[0]
+    assert out == ref
+
+
 def test_sharded_engine_pallas_rejects_indivisible_heads():
     """tp that does not divide the kv heads must fail loudly at engine
     construction, not silently gather or miscompute."""
@@ -487,7 +515,8 @@ def test_step_timing_accounting_sums():
     assert t["steps"] == steps
     assert 0 < t["windows"] <= steps
     assert t["device_s"] > 0 and t["host_s"] > 0
-    total = t["device_s"] + t["host_s"]
+    assert t["prefill_s"] > 0               # admission burst, own bucket
+    total = t["device_s"] + t["host_s"] + t["prefill_s"]
     # The split partitions each step's wall time exactly; across steps it
     # must match the loop's wall clock minus inter-step Python overhead.
     assert total <= wall

@@ -65,19 +65,16 @@ def main() -> int:
     print(json.dumps({"layout": "plain", "ms_per_step": round(base * 1e3, 1)}))
     for pp in (2, 4):
         dp = 8 // pp
-        seen = set()
-        for sched, M, V in (
-            ("gpipe", 2, 1), ("gpipe", 4, 1), ("gpipe", 8, 1),
-            ("interleaved", 2, 2), ("interleaved", 2, 4),
-            ("interleaved", pp, 2), ("interleaved", pp, 4),
-        ):
-            if (sched, M, V) in seen:
-                continue
-            seen.add((sched, M, V))
-            if M > 8 or (sched == "interleaved" and M > pp):
-                continue
-            if 8 % (pp * V):
-                continue
+        # GPipe amortizes with M; interleaved holds M <= pp and raises V
+        # (L=8 layers bound V to 8/pp chunks per device).
+        combos = [("gpipe", M, 1) for M in (2, 4, 8)]
+        combos += [
+            ("interleaved", M, V)
+            for M in sorted({2, pp})
+            for V in (2, 4)
+            if M <= pp and 8 % (pp * V) == 0
+        ]
+        for sched, M, V in combos:
             ms = run({
                 "pp": pp, "dp": dp, "pp_microbatches": M,
                 "pp_schedule": sched, "pp_virtual_stages": V,
