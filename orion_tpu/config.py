@@ -172,6 +172,17 @@ class ModelConfig:
         """Gated feed-forwards (a w_gate matrix): SwiGLU and GeGLU."""
         return self.activation in ("swiglu", "geglu")
 
+    @property
+    def window_pattern(self) -> Optional[int]:
+        """The interleaved local/global layer grouping, iff ACTIVE (a
+        sliding window is set and a pattern configured). Single source of
+        truth for 'this model scans/pipelines in groups' — transformer
+        forward and trainer pp validation both key off it."""
+        return (
+            self.sliding_window_pattern
+            if self.sliding_window is not None else None
+        )
+
     def layer_window(self, layer: int) -> Optional[int]:
         """The sliding window for a given layer index (None = global).
 

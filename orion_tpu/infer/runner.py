@@ -64,10 +64,7 @@ def _scan_layers(params: Params, cfg: ModelConfig, body, init_carry):
     ``pattern`` layers with one body call per static position.
     """
     L = cfg.n_layers
-    pattern = (
-        cfg.sliding_window_pattern
-        if cfg.sliding_window is not None else None
-    )
+    pattern = cfg.window_pattern
     if cfg.scan_layers:
         if pattern is None:
             def scan_body(carry, xs):

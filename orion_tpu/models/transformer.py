@@ -480,10 +480,33 @@ def _hidden_states(
             )
         return block_fn
 
-    pattern = (
-        cfg.sliding_window_pattern
-        if cfg.sliding_window is not None else None
-    )
+    def pattern_groups(pattern: int):
+        """(grouped_blocks, group_fn) for interleaved local/global models:
+        the window is static per pattern position, so a GROUP of `pattern`
+        layers is the homogeneous unit both the layer scan and the
+        pipeline iterate (shared so the two paths cannot diverge)."""
+        L = cfg.n_layers
+        if L % pattern:
+            raise ValueError(
+                f"n_layers={L} must be divisible by "
+                f"sliding_window_pattern={pattern}"
+            )
+        fns = [make_block_fn(cfg.layer_window(j)) for j in range(pattern)]
+        grouped = jax.tree.map(
+            lambda a: a.reshape(L // pattern, pattern, *a.shape[1:]),
+            params["blocks"],
+        )
+
+        def group_fn(carry, gbp):
+            aux_t = jnp.zeros((), jnp.float32)
+            for j, f in enumerate(fns):
+                carry, aux = f(carry, jax.tree.map(lambda a: a[j], gbp))
+                aux_t = aux_t + aux
+            return carry, aux_t
+
+        return grouped, group_fn
+
+    pattern = cfg.window_pattern
     pp_active = (
         cfg.pipeline_axis is not None
         and mesh is not None
@@ -497,17 +520,21 @@ def _hidden_states(
                 "pipeline parallelism does not support packed sequences "
                 "(segment_ids/custom positions are per-row state)"
             )
-        if pattern is not None:
-            raise ValueError(
-                "pipeline parallelism does not support "
-                "sliding_window_pattern (layers are not homogeneous)"
-            )
         from orion_tpu.parallel.pipeline import pipeline_forward
+
+        if pattern is None:
+            pp_blocks = params["blocks"]
+            pp_fn = make_block_fn(cfg.sliding_window)
+        else:
+            # Window-pattern (Gemma-family) models pipeline over pattern
+            # GROUPS — the grouped-scan unit, lifted into the stage body
+            # (the trainer validates the unit count splits over pp*V).
+            pp_blocks, pp_fn = pattern_groups(pattern)
 
         x, moe_aux = pipeline_forward(
             x,
-            params["blocks"],
-            make_block_fn(cfg.sliding_window),
+            pp_blocks,
+            pp_fn,
             mesh,
             axis=cfg.pipeline_axis,
             num_microbatches=cfg.pp_microbatches,
@@ -522,33 +549,9 @@ def _hidden_states(
             )
             moe_aux = aux.sum()
         else:
-            # Interleaved local/global layers (Gemma-family): the window is
-            # STATIC in every kernel, so scan over GROUPS of `pattern`
-            # layers, each group position having its own (static) window.
-            L = cfg.n_layers
-            if L % pattern:
-                raise ValueError(
-                    f"n_layers={L} must be divisible by "
-                    f"sliding_window_pattern={pattern}"
-                )
-            fns = [make_block_fn(cfg.layer_window(j))
-                   for j in range(pattern)]
-            grouped = jax.tree.map(
-                lambda a: a.reshape(
-                    L // pattern, pattern, *a.shape[1:]
-                ),
-                params["blocks"],
-            )
-
-            def group_fn(carry, gbp):
-                aux_t = jnp.zeros((), jnp.float32)
-                for j, f in enumerate(fns):
-                    carry, aux = f(
-                        carry, jax.tree.map(lambda a: a[j], gbp)
-                    )
-                    aux_t = aux_t + aux
-                return carry, aux_t
-
+            # Interleaved local/global layers (Gemma-family): scan over
+            # pattern GROUPS (shared unit with the pipeline branch).
+            grouped, group_fn = pattern_groups(pattern)
             x, aux = jax.lax.scan(
                 group_fn, x, grouped, unroll=cfg.scan_unroll
             )

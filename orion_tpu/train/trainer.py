@@ -279,10 +279,16 @@ class Trainer:
             # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
             pp, M = cfg.parallel.pp, cfg.parallel.pp_microbatches
             micro = cfg.data.batch_size // max(cfg.train.grad_accum, 1)
-            if cfg.model.n_layers % pp:
+            # Window-pattern (Gemma-family) models pipeline over GROUPS of
+            # `pattern` layers (the homogeneous unit); otherwise the unit
+            # is a single layer. Same source of truth as the forward pass
+            # (ModelConfig.window_pattern).
+            unit = cfg.model.window_pattern or 1
+            n_units, rem = divmod(cfg.model.n_layers, unit)
+            if rem or n_units % pp:
                 raise ValueError(
-                    f"model.n_layers={cfg.model.n_layers} must be divisible "
-                    f"by parallel.pp={pp}"
+                    f"model.n_layers={cfg.model.n_layers} must split into "
+                    f"pattern groups of {unit} divisible by parallel.pp={pp}"
                 )
             if M < 1 or micro % M:
                 raise ValueError(
@@ -294,10 +300,11 @@ class Trainer:
             sched = cfg.parallel.pp_schedule
             V = cfg.parallel.pp_virtual_stages
             if sched == "interleaved":
-                if cfg.model.n_layers % (pp * V):
+                if n_units % (pp * V):
                     raise ValueError(
-                        f"model.n_layers={cfg.model.n_layers} must be "
-                        f"divisible by pp*pp_virtual_stages ({pp}*{V})"
+                        f"model.n_layers={cfg.model.n_layers} gives "
+                        f"{n_units} pipeline units (pattern {unit}); must "
+                        f"be divisible by pp*pp_virtual_stages ({pp}*{V})"
                     )
                 if M > pp:
                     raise ValueError(
