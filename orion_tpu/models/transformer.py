@@ -461,14 +461,25 @@ def _hidden_states(
     with jax.named_scope("embed"):
         x = embed(params, tokens, positions, cfg)
 
-    def make_block_fn(window: Optional[int]):
-        def block_fn(carry, bp):
-            pos = positions
-            if pos.shape[0] != carry.shape[0]:
-                pos = jnp.broadcast_to(
-                    pos[:1], (carry.shape[0], pos.shape[1])
+    def make_block_fn(window: Optional[int], with_rs: bool = False):
+        """Per-layer body. ``with_rs`` (the packed-pipeline path) takes the
+        per-row state (positions/segment_ids, already microbatch-sliced by
+        the pipeline) as a third argument instead of closing over the
+        full-batch arrays."""
+        if with_rs:
+            def block_fn(carry, bp, rs):
+                return _block(
+                    carry, bp, cfg, rs["positions"],
+                    rs.get("segment_ids"), mesh, window,
                 )
-            return _block(carry, bp, cfg, pos, segment_ids, mesh, window)
+        else:
+            def block_fn(carry, bp):
+                pos = positions
+                if pos.shape[0] != carry.shape[0]:
+                    pos = jnp.broadcast_to(
+                        pos[:1], (carry.shape[0], pos.shape[1])
+                    )
+                return _block(carry, bp, cfg, pos, segment_ids, mesh, window)
 
         if cfg.remat == "full":
             return jax.checkpoint(block_fn)
@@ -480,7 +491,7 @@ def _hidden_states(
             )
         return block_fn
 
-    def pattern_groups(pattern: int):
+    def pattern_groups(pattern: int, with_rs: bool = False):
         """(grouped_blocks, group_fn) for interleaved local/global models:
         the window is static per pattern position, so a GROUP of `pattern`
         layers is the homogeneous unit both the layer scan and the
@@ -491,16 +502,22 @@ def _hidden_states(
                 f"n_layers={L} must be divisible by "
                 f"sliding_window_pattern={pattern}"
             )
-        fns = [make_block_fn(cfg.layer_window(j)) for j in range(pattern)]
+        fns = [make_block_fn(cfg.layer_window(j), with_rs)
+               for j in range(pattern)]
         grouped = jax.tree.map(
             lambda a: a.reshape(L // pattern, pattern, *a.shape[1:]),
             params["blocks"],
         )
 
-        def group_fn(carry, gbp):
+        def group_fn(carry, gbp, *rs):
+            # *rs absorbs the optional row-state argument, so the same
+            # function serves both the 2-arg (scan) and 3-arg (packed
+            # pipeline) calling conventions.
             aux_t = jnp.zeros((), jnp.float32)
             for j, f in enumerate(fns):
-                carry, aux = f(carry, jax.tree.map(lambda a: a[j], gbp))
+                carry, aux = f(
+                    carry, jax.tree.map(lambda a: a[j], gbp), *rs
+                )
                 aux_t = aux_t + aux
             return carry, aux_t
 
@@ -515,21 +532,27 @@ def _hidden_states(
     if pp_active:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires scan_layers=True")
-        if segment_ids is not None or custom_positions:
-            raise ValueError(
-                "pipeline parallelism does not support packed sequences "
-                "(segment_ids/custom positions are per-row state)"
-            )
         from orion_tpu.parallel.pipeline import pipeline_forward
+
+        # Packed sequences / custom positions are PER-ROW state: the
+        # pipeline slices them per microbatch and each stage looks its
+        # active slice up by index (they never ride the ppermute ring),
+        # so packing composes with pp (r4 restriction lifted, round 5).
+        with_rs = segment_ids is not None or custom_positions
+        row_state = None
+        if with_rs:
+            row_state = {"positions": positions}
+            if segment_ids is not None:
+                row_state["segment_ids"] = segment_ids
 
         if pattern is None:
             pp_blocks = params["blocks"]
-            pp_fn = make_block_fn(cfg.sliding_window)
+            pp_fn = make_block_fn(cfg.sliding_window, with_rs)
         else:
             # Window-pattern (Gemma-family) models pipeline over pattern
             # GROUPS — the grouped-scan unit, lifted into the stage body
             # (the trainer validates the unit count splits over pp*V).
-            pp_blocks, pp_fn = pattern_groups(pattern)
+            pp_blocks, pp_fn = pattern_groups(pattern, with_rs)
 
         x, moe_aux = pipeline_forward(
             x,
@@ -540,6 +563,7 @@ def _hidden_states(
             num_microbatches=cfg.pp_microbatches,
             schedule=cfg.pp_schedule,
             virtual_stages=cfg.pp_virtual_stages,
+            row_state=row_state,
         )
     elif cfg.scan_layers:
         if pattern is None:

@@ -74,19 +74,25 @@ BlockFn = Callable[[jax.Array, Any], Tuple[jax.Array, jax.Array]]
 def pipeline_forward(
     x: jax.Array,                 # [B, S, D] (batch auto-sharded on dp/fsdp)
     blocks: Any,                  # stacked per-layer params, leaves [L, ...]
-    block_fn: BlockFn,            # (x [b,S,D], layer_params) -> (y, aux)
+    block_fn: BlockFn,            # (x [b,S,D], layer_params[, row_state])
     mesh: Mesh,
     *,
     axis: str = "pp",
     num_microbatches: int = 1,
     schedule: str = "gpipe",
     virtual_stages: int = 1,
+    row_state: Any = None,        # pytree of [B, ...] per-row arrays
 ) -> tuple[jax.Array, jax.Array]:
     """Run the layer stack as a GPipe pipeline; returns (x_out, aux_sum).
 
-    Requirements (validated by the trainer): L % pp == 0, B % M == 0, and
-    per-sequence state like packed segment_ids must be absent (positions must
-    be batch-uniform, which the default arange positions are).
+    Requirements (validated by the trainer): L % pp == 0, B % M == 0.
+
+    ``row_state`` carries per-row batch state (packed segment_ids, custom
+    positions) through microbatching: leaves are [B, ...] arrays sliced to
+    [M, mb, ...], and each tick's stage LOOKS UP its active microbatch's
+    slice by index — row state never rides the ppermute ring (it is a
+    static input, unlike the activation). With row_state, ``block_fn`` is
+    called as ``block_fn(x, layer_params, rs)``.
 
     ``schedule='interleaved'`` runs the virtual-stage schedule (module
     docstring): ``virtual_stages`` chunks per device, M <= pp required.
@@ -96,10 +102,14 @@ def pipeline_forward(
             f"unknown pp_schedule {schedule!r}; expected 'gpipe' or "
             f"'interleaved'"
         )
+
+    def call(c, bp, rs):
+        return block_fn(c, bp) if row_state is None else block_fn(c, bp, rs)
+
     pp = mesh.shape.get(axis, 1)
     if pp == 1:
         def scan_fn(c, bp):
-            y, aux = block_fn(c, bp)
+            y, aux = call(c, bp, row_state)
             return y, aux
         x, aux = lax.scan(scan_fn, x, blocks)
         return x, aux.sum()
@@ -111,9 +121,12 @@ def pipeline_forward(
     L = jax.tree.leaves(blocks)[0].shape[0]
     if L % pp:
         raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+    rs_mb = jax.tree.map(
+        lambda a: a.reshape(M, B // M, *a.shape[1:]), row_state
+    )
     if schedule == "interleaved":
         return _interleaved_pipeline(
-            x, blocks, block_fn, mesh, axis, M, virtual_stages
+            x, blocks, call, mesh, axis, M, virtual_stages, rs_mb
         )
     mb = B // M
 
@@ -124,7 +137,7 @@ def pipeline_forward(
     )
     x_mb = x.reshape(M, mb, S, D)
 
-    def local(x_mb, staged):
+    def local(x_mb, staged, rs_mb):
         stage_params = jax.tree.map(lambda a: a[0], staged)  # [L/pp, ...]
         stage = lax.axis_index(axis)
         npp = lax.axis_size(axis)
@@ -132,9 +145,9 @@ def pipeline_forward(
         T = M + npp - 1
         fwd_perm = [(i, i + 1) for i in range(npp - 1)]
 
-        def run_stage(c):
+        def run_stage(c, rs):
             def scan_fn(h, bp):
-                y, aux = block_fn(h, bp)
+                y, aux = call(h, bp, rs)
                 return y, aux
             y, aux = lax.scan(scan_fn, c, stage_params)
             return y, aux.sum()
@@ -143,9 +156,14 @@ def pipeline_forward(
             state, outputs, aux_acc = carry
             inject = x_mb[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(stage == 0, inject, state)
+            # Row state is looked up by this stage's active microbatch
+            # index (t - stage) — static input, never ppermuted.
+            rs = jax.tree.map(
+                lambda a: a[jnp.clip(t - stage, 0, M - 1)], rs_mb
+            )
             # Bubble ticks run on garbage and are masked below: uniform
             # control flow keeps the auto-axis collectives unconditional.
-            out, aux_t = run_stage(cur)
+            out, aux_t = run_stage(cur, rs)
             active = (t >= stage) & (t - stage < M)
             aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
             out_idx = jnp.clip(t - (npp - 1), 0, M - 1)
@@ -179,21 +197,22 @@ def pipeline_forward(
     outputs, aux = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(P(), P(axis), jax.tree.map(lambda _: P(), rs_mb)),
         out_specs=(P(), P()),
         axis_names={axis},
-    )(x_mb, staged)
+    )(x_mb, staged, rs_mb)
     return outputs.reshape(B, S, D), aux
 
 
 def _interleaved_pipeline(
     x: jax.Array,
     blocks: Any,
-    block_fn: BlockFn,
+    call,                  # call(x, layer_params, rs) -> (y, aux)
     mesh: Mesh,
     axis: str,
     M: int,
     V: int,
+    rs_mb: Any = None,     # row-state leaves [M, mb, ...] (see caller)
 ) -> tuple[jax.Array, jax.Array]:
     """Virtual-stage (interleaved) schedule: chunk c of V*pp lives on device
     c mod pp; tick t runs chunk s on microbatch t-s; ppermute is the full
@@ -242,7 +261,7 @@ def _interleaved_pipeline(
     )
     x_mb = x.reshape(M, mb, S, D)
 
-    def local(x_mb, staged):
+    def local(x_mb, staged, rs_mb):
         chunks = jax.tree.map(lambda a: a[0], staged)   # [V, Lc, ...]
         stage = lax.axis_index(axis)
         npp = lax.axis_size(axis)
@@ -250,14 +269,14 @@ def _interleaved_pipeline(
         ring = [(i, (i + 1) % npp) for i in range(npp)]
         is_last = stage == npp - 1
 
-        def run_chunk(c, j):
+        def run_chunk(c, j, rs):
             cp = jax.tree.map(
                 lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
                 chunks,
             )
 
             def scan_fn(h, bp):
-                y, aux = block_fn(h, bp)
+                y, aux = call(h, bp, rs)
                 return y, aux
 
             y, aux = lax.scan(scan_fn, c, cp)
@@ -272,7 +291,12 @@ def _interleaved_pipeline(
             # other (device, lap) consumes the ppermuted activation.
             inject = x_mb[jnp.clip(t, 0, M - 1)]
             cur = jnp.where((stage == 0) & (t < M), inject, state)
-            out, aux_t = run_chunk(cur, j)
+            # Active microbatch index: dt mod npp (lap-invariant); row
+            # state is a static lookup, never ppermuted.
+            rs = jax.tree.map(
+                lambda a: a[jnp.clip(dt % npp, 0, M - 1)], rs_mb
+            )
+            out, aux_t = run_chunk(cur, j, rs)
             aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
             # The final chunk (device pp-1, lap V-1) emits mb m at tick
             # t = m + V*pp - 1.
@@ -302,8 +326,8 @@ def _interleaved_pipeline(
     outputs, aux = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(P(), P(axis), jax.tree.map(lambda _: P(), rs_mb)),
         out_specs=(P(), P()),
         axis_names={axis},
-    )(x_mb, staged)
+    )(x_mb, staged, rs_mb)
     return outputs.reshape(B, S, D), aux

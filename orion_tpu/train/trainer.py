@@ -260,11 +260,6 @@ class Trainer:
                 "model.weight_quant is a serving-only knob (the engine "
                 "quantizes at init); training runs full-precision masters"
             )
-        if cfg.data.packed and cfg.parallel.pp > 1:
-            raise ValueError(
-                "data.packed is incompatible with parallel.pp: pipeline "
-                "microbatching cannot carry per-row segment state"
-            )
         if (
             cfg.parallel.pp_virtual_stages != 1
             and cfg.parallel.pp_schedule != "interleaved"

@@ -237,18 +237,33 @@ def test_packed_training_runs_and_learns():
     assert hist[-1].loss < hist[0].loss - 0.3, (hist[0].loss, hist[-1].loss)
 
 
-def test_packed_rejects_pipeline():
+def test_packed_training_composes_with_pipeline():
+    """Packed rows x pp (r4 restriction lifted): pipelined packed training
+    matches the single-layout packed trajectory — segment masks and
+    per-doc positions slice per microbatch and are looked up per stage."""
+    import jax as _jax
+    import numpy as _np
+
     from orion_tpu.config import get_config
     from orion_tpu.train import Trainer
-    import pytest as _pytest
 
-    cfg = get_config(
-        "tiny-llama",
-        ["runtime.platform=cpu", "data.packed=true", "parallel.pp=2",
-         "parallel.pp_microbatches=2", "data.batch_size=8"],
-    )
-    with _pytest.raises(ValueError, match="packed"):
-        Trainer(cfg)
+    def run(axes):
+        overrides = [
+            "runtime.platform=cpu", "data.packed=true", "data.batch_size=4",
+            "data.seq_len=32", "train.num_steps=3", "train.log_interval=100",
+            "optimizer.warmup_steps=1",
+        ] + [f"parallel.{k}={v}" for k, v in axes.items()]
+        t = Trainer(get_config("tiny-llama", overrides))
+        state, _ = t.restore_or_init()
+        losses = []
+        for step in range(3):
+            state, m = t.train_step(state, t.global_batch(step))
+            losses.append(float(_jax.device_get(m["loss"])))
+        return losses
+
+    base = run({})
+    pp = run({"pp": 2, "pp_microbatches": 2})
+    _np.testing.assert_allclose(pp, base, rtol=2e-4)
 
 
 def test_pack_rows_skips_degenerate_docs():

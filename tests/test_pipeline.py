@@ -69,20 +69,57 @@ def test_pipeline_moe_aux_matches(cpu_devices):
     np.testing.assert_allclose(float(aux), float(ref_aux), rtol=2e-2)
 
 
-def test_pipeline_gemma2_window_pattern_matches_scan(cpu_devices):
+@pytest.mark.parametrize("schedule,V,L", [("gpipe", 1, 4),
+                                          ("interleaved", 2, 8)])
+def test_pipeline_gemma2_window_pattern_matches_scan(
+    cpu_devices, schedule, V, L
+):
     """Window-PATTERN (Gemma-2 interleaved local/global) models pipeline
     over GROUPS of `pattern` layers — the round-4 'cannot be pipelined'
     restriction, lifted: per-group static windows, post-norms, dual
-    softcaps, exact output parity vs the grouped layer scan."""
-    mcfg = get_config("tiny-gemma2").model
+    softcaps, exact output parity vs the grouped layer scan, under BOTH
+    schedules (interleaved needs L/pattern units divisible by pp*V)."""
+    mcfg = dataclasses.replace(get_config("tiny-gemma2").model, n_layers=L)
     params = init_params(mcfg, jax.random.key(0))
     tokens = _tokens(jax.random.key(1))
     ref, _ = forward(params, tokens, mcfg)
 
     mesh = make_mesh(cpu_devices, pp=2, dp=4)
-    pcfg = dataclasses.replace(mcfg, pipeline_axis="pp", pp_microbatches=2)
+    pcfg = dataclasses.replace(
+        mcfg, pipeline_axis="pp", pp_microbatches=2,
+        pp_schedule=schedule, pp_virtual_stages=V,
+    )
     out, _ = jax.jit(
         lambda p, t: forward(p, t, pcfg, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_pipeline_gemma2_packed_matches_scan(cpu_devices):
+    """The full composition of both lifted restrictions: window-PATTERN
+    groups x packed row state x pipeline — per-layer windows measured on
+    per-doc positions, segment masks sliced per microbatch."""
+    mcfg = get_config("tiny-gemma2").model
+    params = init_params(mcfg, jax.random.key(0))
+    tokens = _tokens(jax.random.key(1))
+    B, S = tokens.shape
+    half = S // 2
+    seg = jnp.concatenate(
+        [jnp.full((B, half), 1, jnp.int32),
+         jnp.full((B, S - half), 2, jnp.int32)], axis=1
+    )
+    pos = jnp.concatenate(
+        [jnp.arange(half, dtype=jnp.int32)[None].repeat(B, 0),
+         jnp.arange(S - half, dtype=jnp.int32)[None].repeat(B, 0)], axis=1
+    )
+    ref, _ = forward(params, tokens, mcfg, segment_ids=seg, positions=pos)
+
+    mesh = make_mesh(cpu_devices, pp=2, dp=4)
+    pcfg = dataclasses.replace(mcfg, pipeline_axis="pp", pp_microbatches=2)
+    out, _ = jax.jit(
+        lambda p, t: forward(
+            p, t, pcfg, segment_ids=seg, positions=pos, mesh=mesh
+        )
     )(params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
@@ -123,14 +160,39 @@ def test_trainer_gemma2_pp_validation():
         ]))
 
 
-def test_pipeline_rejects_packed_sequences(cpu_devices):
-    mcfg = _cfg(pipeline_axis="pp", pp_microbatches=2)
+@pytest.mark.parametrize("schedule,V", [("gpipe", 1), ("interleaved", 2)])
+def test_pipeline_packed_sequences_match_scan(cpu_devices, schedule, V):
+    """Packed rows pipeline (r4 restriction lifted): per-row segment ids
+    and per-doc positions are microbatch-sliced and looked up by each
+    stage (never ppermuted); outputs equal the plain packed scan, under
+    both schedules."""
+    mcfg = _cfg()
     params = init_params(mcfg, jax.random.key(0))
     tokens = _tokens(jax.random.key(1))
+    B, S = tokens.shape
+    # Two documents per row: segments 1/2 split mid-row, positions restart.
+    half = S // 2
+    seg = jnp.concatenate(
+        [jnp.full((B, half), 1, jnp.int32), jnp.full((B, S - half), 2,
+                                                     jnp.int32)], axis=1
+    )
+    pos = jnp.concatenate(
+        [jnp.arange(half, dtype=jnp.int32)[None].repeat(B, 0),
+         jnp.arange(S - half, dtype=jnp.int32)[None].repeat(B, 0)], axis=1
+    )
+    ref, _ = forward(params, tokens, mcfg, segment_ids=seg, positions=pos)
+
     mesh = make_mesh(cpu_devices, pp=2, dp=4)
-    seg = jnp.zeros(tokens.shape, jnp.int32)
-    with pytest.raises(ValueError, match="packed"):
-        forward(params, tokens, mcfg, segment_ids=seg, mesh=mesh)
+    pcfg = dataclasses.replace(
+        mcfg, pipeline_axis="pp", pp_microbatches=2,
+        pp_schedule=schedule, pp_virtual_stages=V,
+    )
+    out, _ = jax.jit(
+        lambda p, t: forward(
+            p, t, pcfg, segment_ids=seg, positions=pos, mesh=mesh
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
 
 def test_trainer_pp_equivalence(cpu_devices):
