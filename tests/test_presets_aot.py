@@ -30,6 +30,12 @@ from orion_tpu.train import Trainer
         # Long-context flagship: full 262144-token sequence through the
         # striped ring (S % sp^2 == 0 holds at sp=8 too).
         ("llama3-8b-256k-ring", {"sp": 8}),
+        # Interleaved virtual-stage pipeline at full 70B size: pp=4, V=4
+        # (80 layers -> 16 chunks of 5, chunk c on device c mod 4),
+        # composed with ZeRO-3 on fsdp=2 (round-5 schedule).
+        ("llama3-70b-fsdp", {"pp": 4, "fsdp": 2, "pp_microbatches": 4,
+                             "pp_schedule": "interleaved",
+                             "pp_virtual_stages": 4}),
     ],
 )
 def test_flagship_preset_train_step_lowers(cpu_devices, preset, axes):
