@@ -35,7 +35,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import AxisType, PartitionSpec as P
 
 from orion_tpu.config import ModelConfig
 
@@ -278,18 +278,24 @@ def moe_mlp_sorted_a2a(
     the einsum path but overflow drops are per-slice rather than global
     slot-major — identical results whenever nothing overflows.
 
-    Composes with dp/fsdp (batch axes pass through) and tp (weights'
-    F axis); NOT with pp (the pipeline already owns a shard_map).
+    Composes with dp/fsdp (batch axes pass through), tp (weights' F
+    axis), and pp: inside the pipeline's pp-manual region this shard_map
+    NESTS, bound to the context abstract mesh (see below).
     """
     sp_ax = cfg.sequence_axis or "sp"
     ep = mesh.shape.get("ep", 1)
     if ep == 1:
         return moe_mlp_sorted(x, params, cfg)
-    if mesh.shape.get("pp", 1) > 1:
-        raise ValueError(
-            "moe_dispatch='sorted_a2a' does not compose with pipeline "
-            "parallelism (nested shard_map); use 'sorted'"
-        )
+    # Inside the pipeline's shard_map (manual over pp) a nested shard_map
+    # must bind the CONTEXT abstract mesh — pp is already marked Manual
+    # there, and re-binding the concrete (all-Auto) mesh is rejected. The
+    # ep/tp/sp/batch axes this dispatch goes manual over are still Auto in
+    # that context, so sorted_a2a composes with pp (r4 restriction lifted,
+    # round 5); per-microbatch token slices only shrink C_loc, the same
+    # per-slice drop semantics as any batch sharding.
+    ctx = jax.sharding.get_abstract_mesh()
+    if any(t == AxisType.Manual for t in getattr(ctx, "axis_types", ())):
+        mesh = ctx
     E = cfg.n_experts
     if E % ep:
         raise ValueError(f"n_experts {E} not divisible by ep={ep}")
