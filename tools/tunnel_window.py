@@ -55,6 +55,22 @@ def _text(x) -> str:
     return x or ""
 
 
+def _json_lines(out: str) -> list:
+    """Every parseable JSON object line in ``out`` — the tools' metric
+    protocol. Stored separately from the tail because the axon runtime
+    floods stdout with logs: round 5 lost bench's train-MFU line to the
+    8000-char tail cap, which is exactly the failure this prevents."""
+    found = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                found.append(json.loads(line))
+            except ValueError:
+                pass
+    return found
+
+
 def main() -> int:
     if "--list" in sys.argv[1:]:
         for name, args, budget in QUEUE:
@@ -79,12 +95,15 @@ def main() -> int:
             r = subprocess.run(args, capture_output=True, text=True,
                                timeout=budget, cwd=str(ROOT), env=env)
             rec = {"tool": name, "at": stamp, "rc": r.returncode,
+                   "metrics": _json_lines(r.stdout),
                    "stdout": r.stdout[-8000:], "stderr": r.stderr[-1000:]}
             worst = max(worst, abs(r.returncode))
         except subprocess.TimeoutExpired as e:
+            out = _text(e.stdout)
             rec = {"tool": name, "at": stamp, "rc": "TIMEOUT",
                    "budget_s": budget,
-                   "stdout": _text(e.stdout)[-8000:],
+                   "metrics": _json_lines(out),
+                   "stdout": out[-8000:],
                    "stderr": _text(e.stderr)[-1000:]}
             worst = max(worst, 1)
         with open(LOG, "a") as f:
