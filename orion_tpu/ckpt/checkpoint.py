@@ -9,9 +9,12 @@ pure functions of the step (see orion_tpu.data).
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 from typing import Any, Optional
 
+import jax
 import orbax.checkpoint as ocp
 
 from orion_tpu.config import CheckpointConfig
@@ -22,6 +25,7 @@ log = logging.getLogger("orion_tpu.ckpt")
 class CheckpointManager:
     def __init__(self, directory: str, cfg: CheckpointConfig):
         self.cfg = cfg
+        self._dir = directory
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -31,6 +35,63 @@ class CheckpointManager:
             ),
         )
 
+    # The data stream is stateless ((seed, step) -> batch), so checkpoints
+    # carry no iterator state — which makes a CHANGE in the stream mapping
+    # silent on resume (ADVICE r4: the round-4 elastic-invariance rework
+    # replays a different token order for pre-rework checkpoints). A tiny
+    # sidecar records the stream format of the LATEST COMMITTED save
+    # (rewritten at every commit, so a format bump stops warning once
+    # old-format checkpoints are gone); restore warns on mismatch instead
+    # of silently training on a different shuffle. Sidecar rather than an
+    # Orbax item: old checkpoints stay restorable unchanged. Stamping
+    # happens only at commit — inline for sync saves, at the wait()
+    # barrier for async ones — so a crash mid-async-save cannot stamp a
+    # directory whose only committed checkpoints are old-format.
+    @property
+    def _fmt_path(self) -> str:
+        return os.path.join(self._dir, "stream_format.json")
+
+    def _stamp_stream_format(self) -> None:
+        from orion_tpu.data.loader import STREAM_FORMAT
+
+        if jax.process_index() != 0:
+            return
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            with open(self._fmt_path, "w") as f:
+                json.dump({"stream_format": STREAM_FORMAT}, f)
+        except OSError as e:          # non-fatal: stamping is advisory
+            log.warning("could not stamp stream format: %s", e)
+
+    def _check_stream_format(self) -> None:
+        from orion_tpu.data.loader import STREAM_FORMAT
+
+        if jax.process_index() != 0:  # one warning per fleet, not per host
+            return
+        try:
+            with open(self._fmt_path) as f:
+                stamp = json.load(f)
+            saved = stamp.get("stream_format") if isinstance(stamp, dict) \
+                else None
+        except FileNotFoundError:
+            log.warning(
+                "checkpoint at %s carries no stream-format stamp (written "
+                "before round 5): if it predates data-stream format %d, "
+                "resume continues on a DIFFERENT token order (see "
+                "data/loader.STREAM_FORMAT)", self._dir, STREAM_FORMAT,
+            )
+            return
+        except (OSError, ValueError) as e:
+            log.warning("could not read stream-format stamp: %s", e)
+            return
+        if saved != STREAM_FORMAT:
+            log.warning(
+                "checkpoint was written under data-stream format %s but "
+                "this build uses format %d: resume will train on a "
+                "different token order than the original run", saved,
+                STREAM_FORMAT,
+            )
+
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Save if the step matches the save interval (or force)."""
         if step in self._mgr.all_steps():
@@ -39,6 +100,10 @@ class CheckpointManager:
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved:
+            if self.cfg.async_save:
+                self._stamp_pending = True   # stamped at the wait() barrier
+            else:
+                self._stamp_stream_format()
             log.info("checkpoint saved at step %d", step)
         return saved
 
@@ -53,6 +118,7 @@ class CheckpointManager:
         step = self._mgr.latest_step()
         if step is None:
             return None
+        self._check_stream_format()
         state = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )
@@ -62,7 +128,10 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until async saves land (call before process exit)."""
         self._mgr.wait_until_finished()
+        if getattr(self, "_stamp_pending", False):
+            self._stamp_pending = False
+            self._stamp_stream_format()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        self.wait()
         self._mgr.close()

@@ -380,3 +380,41 @@ def test_debug_asserts_catch_true_router_corruption():
             jax.block_until_ready(out)
     finally:
         moe._router_topk = orig
+
+
+def test_checkpoint_stream_format_stamp(tmp_path, caplog):
+    """Checkpoints stamp the data-stream format (ADVICE r4): matching
+    formats restore silently; a mismatched or missing stamp warns that
+    resume replays a different token order."""
+    import json
+    import logging
+    import os
+
+    cfg = _cfg(tmp_path, extra=("train.num_steps=4",
+                                "checkpoint.save_interval_steps=2",
+                                "checkpoint.async_save=false"))
+    t = Trainer(cfg)
+    t.fit()
+    stamp = os.path.join(str(tmp_path) + "/ckpt", "stream_format.json")
+    from orion_tpu.data.loader import STREAM_FORMAT
+
+    assert json.load(open(stamp))["stream_format"] == STREAM_FORMAT
+
+    # Matching stamp: no stream-format warning on restore.
+    with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
+        Trainer(cfg).restore_or_init()
+    assert not [r for r in caplog.records if "stream" in r.message]
+    caplog.clear()
+
+    # Mismatched stamp warns loudly.
+    json.dump({"stream_format": 1}, open(stamp, "w"))
+    with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
+        Trainer(cfg).restore_or_init()
+    assert [r for r in caplog.records if "different token order" in r.message]
+    caplog.clear()
+
+    # Missing stamp (pre-round-5 checkpoint) warns too.
+    os.remove(stamp)
+    with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
+        Trainer(cfg).restore_or_init()
+    assert [r for r in caplog.records if "no stream-format stamp" in r.message]

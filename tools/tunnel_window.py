@@ -68,7 +68,10 @@ def _json_lines(out: str) -> list:
                 found.append(json.loads(line))
             except ValueError:
                 pass
-    return found
+    # Keep the LAST entries (the tools' final summary lines matter most):
+    # a runtime whose flood-logging happens to be JSON-shaped must not
+    # blow up the append-only log the way the tail cap exists to prevent.
+    return found[-50:]
 
 
 def main() -> int:
