@@ -10,3 +10,45 @@ batching engine for inference.
 """
 
 __version__ = "0.1.0"
+
+# -- jax API compatibility ---------------------------------------------------
+# The codebase targets the current jax surface (``jax.shard_map`` with
+# ``check_vma``); on older runtimes where shard_map still lives under
+# jax.experimental (and the flag is called check_rep), install an adapter at
+# the same spot so every call site — and tests importing ``jax.shard_map`` —
+# runs unchanged. No-op on new jax.
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def _shard_map_compat(
+        f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
+        axis_names=None, **kw
+    ):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        if axis_names is not None:
+            # New-jax partial-manual selection; old spelling is the
+            # complementary ``auto`` axis set.
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _esm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    _jax.shard_map = _shard_map_compat
+
+if not hasattr(_jax.lax, "axis_size"):
+    def _axis_size(name):
+        # psum of a literal constant-folds to the static axis size.
+        return _jax.lax.psum(1, name)
+
+    _jax.lax.axis_size = _axis_size
+
+if not hasattr(_jax.lax, "pcast"):
+    def _pcast(x, *args, **kwargs):
+        # pcast only annotates replication for the new check_vma machinery;
+        # under the old shard_map (check_rep=False) identity is correct.
+        return x
+
+    _jax.lax.pcast = _pcast

@@ -419,6 +419,20 @@ class InferenceConfig:
     # Decode is HBM-bound on params + KV traffic, so halving KV bytes buys
     # throughput directly at long contexts (see PERF.md serving notes).
     kv_quant: Optional[str] = None
+    # Automatic prefix caching (vLLM/SGLang-style): finished/preempted
+    # requests donate their full KV pages to a host-side radix tree
+    # (infer/prefix_cache.py); new requests map the longest cached prefix
+    # at page granularity (refcounted, immutable) and prefill only the
+    # uncached tail. Cached pages are reclaimable pool headroom: LRU
+    # eviction hands them back to the allocator under pressure, so the
+    # admission math is unchanged in the worst case. Off by default; the
+    # dominant win is shared-system-prompt traffic (see README "Prefix
+    # caching" and tools/prefix_cache_bench.py).
+    prefix_cache: bool = False
+    # Minimum matched pages worth mapping: shorter matches prefill cold
+    # (mapping a 1-page prefix costs table/refcount churn for little gain
+    # when page_size is small).
+    prefix_cache_min_pages: int = 1
 
 
 @dataclass(frozen=True)

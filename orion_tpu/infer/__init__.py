@@ -9,12 +9,14 @@ streams requests in and tokens out.
 
 from orion_tpu.infer.engine import InferenceEngine, Request
 from orion_tpu.infer.kv_cache import PageAllocator, init_cache
+from orion_tpu.infer.prefix_cache import PrefixCache
 from orion_tpu.infer.sampling import sample
 
 __all__ = [
     "InferenceEngine",
     "Request",
     "PageAllocator",
+    "PrefixCache",
     "init_cache",
     "sample",
 ]

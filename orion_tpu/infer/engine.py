@@ -23,9 +23,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.config import Config
-from orion_tpu.infer.kv_cache import PageAllocator, init_cache, pages_per_seq
+from orion_tpu.infer.kv_cache import (
+    PageAllocator,
+    copy_page,
+    init_cache,
+    pages_per_seq,
+)
 from orion_tpu.infer.runner import decode_window, prefill_step
 from orion_tpu.infer.sampling import sample
+from orion_tpu.metrics import PrefixCacheStats
 
 log = logging.getLogger("orion_tpu.infer")
 
@@ -65,6 +71,11 @@ class Request:
     done: bool = False
     admit_seq: int = -1   # admission order; preemption evicts the youngest
     freed_until: int = 0  # logical pages below this are freed (SWA rolling)
+    # Prefix-cache state: the first n_prefix entries of ``pages`` are
+    # SHARED (refcounted, immutable) cache pages; prefix_node pins their
+    # radix-tree path against eviction until release.
+    n_prefix: int = 0
+    prefix_node: Optional[Any] = None
 
     @property
     def context(self) -> list[int]:
@@ -150,6 +161,23 @@ class InferenceEngine:
                 for name, arr in self.cache.items()
             }
         self.alloc = PageAllocator(self.icfg.num_pages)
+        # Automatic prefix caching (inference.prefix_cache): radix tree of
+        # immutable refcounted KV pages over the SAME allocator — cached
+        # pages are reclaimable headroom, evicted LRU under pressure.
+        self._pcache = None
+        self.prefix_stats = PrefixCacheStats()
+        if self.icfg.prefix_cache:
+            from orion_tpu.infer.prefix_cache import PrefixCache
+
+            self._pcache = PrefixCache(self.psz, self.alloc)
+        self._cow = jax.jit(
+            partial(
+                copy_page,
+                n_layers=self.mcfg.n_layers,
+                num_pages=self.icfg.num_pages,
+            ),
+            donate_argnums=(0,),
+        )
         self.page_table = np.zeros(
             (self.max_batch, self.pages_per_seq), np.int32
         )
@@ -359,9 +387,14 @@ class InferenceEngine:
     def reset_timing(self) -> dict:
         """Return and zero the accumulated step timing split: device_s
         (decode dispatch -> token fetch), prefill_s (admission bursts),
-        host_s (scheduler remainder), windows/steps counters, and the
-        slot_steps/wasted_steps decode-waste tally."""
+        host_s (scheduler remainder), windows/steps counters, the
+        slot_steps/wasted_steps decode-waste tally, and — with
+        inference.prefix_cache — the prefix-cache counters (prefix_hits/
+        misses/hit_rate, cached_tokens, inserted/evicted/cow pages)."""
         out, self.timing = self.timing, self._zero_timing()
+        if self._pcache is not None:
+            out.update(self.prefix_stats.as_timing())
+            self.prefix_stats = PrefixCacheStats()
         return out
 
     def _autotune_window(self, step_total: float) -> None:
@@ -381,6 +414,15 @@ class InferenceEngine:
                 host / denom, self.icfg.decode_host_share_target,
                 self.decode_window,
             )
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every cached prefix (idle cached pages return to the free
+        list); returns the number of pages released. Live requests keep
+        their shared pages through their own refs. No-op when
+        inference.prefix_cache is off."""
+        if self._pcache is None:
+            return 0
+        return self._pcache.clear()
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(
@@ -488,6 +530,81 @@ class InferenceEngine:
         need = np.maximum(n_real + 1, first_window - first_live + 1)
         return int(need.max())
 
+    def _available(self) -> int:
+        """Pool headroom the scheduler may count on: free pages plus every
+        cached page no live request has pinned — the cache is reclaimable
+        headroom, not a separate budget (one pool, one invariant)."""
+        ev = self._pcache.evictable_pages() if self._pcache is not None else 0
+        return self.alloc.free_pages + ev
+
+    def _alloc_pages(self, n: int) -> list[int]:
+        """Allocate n pages, evicting LRU prefix-cache pages as needed."""
+        short = n - self.alloc.free_pages
+        if short > 0 and self._pcache is not None:
+            self.prefix_stats.evicted_pages += self._pcache.evict(short)
+        return self.alloc.alloc(n)
+
+    def _match_prefix(self, context: list[int]):
+        """(n_match, pages, node): longest usable cached prefix of
+        ``context``, page-granular, LOCKED against eviction (the caller
+        owns the unlock). Always leaves at least the final token to
+        recompute — a full-page-multiple full match is allowed (the COW
+        admission path recomputes the last token via decode)."""
+        if self._pcache is None:
+            return 0, [], None
+        cap = len(context) // self.psz
+        if self.page_window is not None:
+            # SWA: never take the COW full-match path, and only accept
+            # matches at least as deep as the cold dead-page boundary —
+            # a shallower match would have to ALLOCATE live prefix pages
+            # for the tail prefill to read, pages a cold admission never
+            # materializes, breaking the pool-holds-this-request-alone
+            # accounting submit() checked against.
+            cap = (len(context) - 1) // self.psz
+        pages, node = self._pcache.match(context, cap)
+        n_match = len(pages)
+        ok = n_match >= max(self.icfg.prefix_cache_min_pages, 1)
+        if ok and self.page_window is not None:
+            ok = n_match >= self._first_live_page(len(context))
+        if not ok:
+            if node is not None:
+                self._pcache.unlock(node)
+            return 0, [], None
+        return n_match, pages, node
+
+    def _admission_need_warm(
+        self, context_len: int, n_match: int, full: bool
+    ) -> tuple[int, int, int, int]:
+        """(n_pages, first_live, n_alloc, need) for a prefix-matched
+        admission: ``n_alloc`` fresh pool pages (the uncached tail — exact
+        page count, no bucket padding — or the single COW page on a full
+        match), ``need`` the same live-prefill + first-decode-window
+        demand _admission_need computes for cold admissions. Always
+        <= the cold need submit() validated the pool against."""
+        psz = self.psz
+        if full:
+            # Whole context cached: decode restarts at position len-1,
+            # rewriting the final token's KV slot in a COW'd private copy
+            # of the last matched page.
+            n_pages, n_alloc = n_match, 1
+            last = min(
+                context_len - 1 + self._provision_window - 1,
+                self.icfg.max_seq_len - 1,
+            )
+        else:
+            n_pages = -(-context_len // psz)
+            n_alloc = n_pages - n_match
+            last = min(
+                context_len + self._provision_window - 1,
+                self.icfg.max_seq_len - 1,
+            )
+        first_window = min(last // psz + 1, self.pages_per_seq)
+        first_live = (
+            self._first_live_page(n_match * psz) if not full else 0
+        )
+        need = max(n_alloc + 1, n_alloc + first_window - n_pages + 1)
+        return n_pages, first_live, n_alloc, need
+
     @property
     def _provision_window(self) -> int:
         """The decode window the pool must budget for: with auto-tune on,
@@ -558,24 +675,92 @@ class InferenceEngine:
             if slot is None:
                 break
             context = req.context
-            s_pad = self._bucket_len(len(context))
-            # Sliding window: logical pages wholly behind the window are
-            # dead on arrival (decode will never read them) — their table
-            # entries point at scratch page 0 and no pool page is spent.
-            # `need` also reserves the first decode window's
-            # pre-provisioning: admitting on the prefill footprint alone
-            # would let _grow_pages preempt the request right back out in
-            # the same step when decode_window > page_size.
-            n_pages, first_live, need = self._admission_need(len(context))
-            n_real = n_pages - first_live
-            if self.alloc.free_pages - reserved < need:
+            # Prefix cache: map the longest cached prefix (shared,
+            # refcount++) and prefill only the uncached tail. The matched
+            # path is locked (evict-proof) from here until release.
+            n_match, m_pages, m_node = self._match_prefix(context)
+            full = bool(n_match) and n_match * self.psz >= len(context)
+            if full:
+                temp = (
+                    self.icfg.temperature
+                    if req.temperature is None else req.temperature
+                )
+                if temp != 0.0:
+                    # Sampled request: the zero-prefill path would draw its
+                    # first token from the decode key stream where the cold
+                    # engine draws it from the prefill stream — breaking
+                    # sampled cache-on/off byte-equivalence. Fall back to a
+                    # one-page tail re-prefill (still n_match-1 pages
+                    # shared); greedy requests keep the zero-prefill path
+                    # (argmax is key-independent).
+                    full = False
+                    n_match = (len(context) - 1) // self.psz
+                    if n_match < max(self.icfg.prefix_cache_min_pages, 1):
+                        self._pcache.unlock(m_node)
+                        n_match, m_pages, m_node = 0, [], None
+                    else:
+                        m_pages = m_pages[:n_match]
+            if n_match:
+                n_pages, first_live, n_alloc, need = (
+                    self._admission_need_warm(len(context), n_match, full)
+                )
+                s_pad = self._bucket_len(len(context) - n_match * self.psz)
+            else:
+                # Sliding window: logical pages wholly behind the window are
+                # dead on arrival (decode will never read them) — their table
+                # entries point at scratch page 0 and no pool page is spent.
+                # `need` also reserves the first decode window's
+                # pre-provisioning: admitting on the prefill footprint alone
+                # would let _grow_pages preempt the request right back out in
+                # the same step when decode_window > page_size.
+                n_pages, first_live, need = self._admission_need(len(context))
+                n_alloc = n_pages - first_live
+                s_pad = self._bucket_len(len(context))
+            if self._available() - reserved < need:
+                if m_node is not None:
+                    self._pcache.unlock(m_node)
                 break  # head-of-line blocking: keep arrival order
-            reserved += need - n_real
+            reserved += need - n_alloc
             self.waiting.popleft()
             req.slot = slot
             req.admit_seq = next(self._admit_seq)
-            req.pages = [None] * first_live + self.alloc.alloc(n_real)
-            req.freed_until = first_live
+            req.prefix_node = m_node
+            if full:
+                # Whole context cached (exact page multiple): no prefill
+                # at all. Copy-on-write the final matched page — the first
+                # decode step rewrites the last token's KV slot, and
+                # shared pages are immutable — then restart decode from
+                # position len-1 with the last context token in flight.
+                cow = self._alloc_pages(1)[0]
+                self.cache = self._cow(
+                    self.cache, jnp.int32(m_pages[-1]), jnp.int32(cow)
+                )
+                for p in m_pages[:-1]:
+                    self.alloc.retain(p)
+                req.pages = list(m_pages[:-1]) + [cow]
+                req.n_prefix = n_match - 1
+                req.freed_until = 0
+                self.prefix_stats.hits += 1
+                self.prefix_stats.cached_tokens += len(context) - 1
+                self.prefix_stats.cow_pages += 1
+            elif n_match:
+                live = m_pages[first_live:]
+                for p in live:
+                    self.alloc.retain(p)
+                req.pages = (
+                    [None] * first_live + list(live)
+                    + self._alloc_pages(n_alloc)
+                )
+                req.n_prefix = n_match
+                req.freed_until = first_live
+                self.prefix_stats.hits += 1
+                self.prefix_stats.cached_tokens += n_match * self.psz
+            else:
+                req.pages = [None] * first_live + self._alloc_pages(n_alloc)
+                req.n_prefix = 0
+                req.freed_until = first_live
+                if self._pcache is not None:
+                    self.prefix_stats.misses += 1
             self.slots[slot] = req
             icfg = self.icfg
             self.slot_temp[slot] = (
@@ -591,8 +776,16 @@ class InferenceEngine:
             self.page_table[slot, :n_pages] = [
                 0 if p is None else p for p in req.pages
             ]
-            self.seq_lens[slot] = len(context)
-            admitted.append((req, s_pad))
+            if full:
+                self.seq_lens[slot] = len(context) - 1
+                self.last_token[slot] = context[-1]
+                if req.max_new_tokens <= 0:
+                    # Scoring request with its whole context cached:
+                    # nothing to compute; reap re-donates the pages.
+                    req.done = True
+            else:
+                self.seq_lens[slot] = len(context)
+                admitted.append((req, s_pad))
 
         # Pass 2 (device). On the pallas path: ONE ragged prefill dispatch
         # for the WHOLE burst, regardless of length mix (VERDICT r3 item
@@ -621,22 +814,39 @@ class InferenceEngine:
     def _prefill_bucket(self, reqs: list[Request], s_pad: int) -> None:
         """Prefill a group of admitted requests in one dispatch; rows may
         be shorter than ``s_pad`` (their tail positions write to the
-        scratch page and their compute blocks skip via segment ids)."""
+        scratch page and their compute blocks skip via segment ids).
+        Prefix-matched rows carry only their uncached TAIL here — the
+        prefix page ids ride along for the mid-sequence attention gather
+        (runner.prefill_step), padded to the burst's max match (power of
+        two, so jit specializations stay bounded)."""
         n_pages = s_pad // self.psz
         nb = 1 << (len(reqs) - 1).bit_length()   # next power of two
         tokens = np.zeros((nb, s_pad), np.int32)
         lengths = np.ones(nb, np.int32)          # pad rows: length 1
         pages = np.zeros((nb, n_pages), np.int32)  # pad rows: scratch page 0
+        max_pre = max(r.n_prefix for r in reqs)
+        p_pre = 1 << (max_pre - 1).bit_length() if max_pre > 0 else 0
+        pre_lens = np.zeros(nb, np.int32)
+        pre_pages = np.zeros((nb, p_pre), np.int32)
         for i, req in enumerate(reqs):
-            context = req.context
-            tokens[i, : len(context)] = context
-            lengths[i] = len(context)
+            npre = req.n_prefix
+            tail = req.context[npre * self.psz:]
+            tokens[i, : len(tail)] = tail
+            lengths[i] = len(tail)
+            pre_lens[i] = npre * self.psz
+            if npre:
+                # Dead (behind-window) matched pages point at scratch 0 —
+                # behind every tail query's window, never attended.
+                pre_pages[i, :npre] = [
+                    0 if p is None else p for p in req.pages[:npre]
+                ]
             # Dead (behind-window) logical pages write to scratch page 0;
             # those positions are never read back (sliding-window mask).
             # Positions past this row's own bucket (shorter than the
             # burst's) go to scratch too.
-            pages[i, : len(req.pages)] = [
-                0 if p is None else p for p in req.pages
+            tail_pg = req.pages[npre:]
+            pages[i, : len(tail_pg)] = [
+                0 if p is None else p for p in tail_pg
             ]
         t0 = time.perf_counter()
         logits, self.cache = self._prefill(
@@ -645,6 +855,8 @@ class InferenceEngine:
             jnp.asarray(tokens),
             jnp.asarray(lengths),
             jnp.asarray(pages),
+            jnp.asarray(pre_lens),
+            jnp.asarray(pre_pages),
         )
         firsts = self._sample(logits, reqs)   # blocks on the device fetch
         self._prefill_span += time.perf_counter() - t0
@@ -657,14 +869,38 @@ class InferenceEngine:
             req.generated.append(first)
             self._maybe_finish(req, first)
 
+    def _release_request(self, req: Request, n_cached: int) -> None:
+        """Release a leaving request's pages. With prefix caching, the
+        contiguous full pages of its context (``n_cached`` tokens hold
+        valid KV) are donated to the radix tree first — on reap AND
+        preempt, so a preempted request re-matches its own pages and
+        re-prefills only what the cache lost. insert() retains what it
+        keeps; the request then drops its own refs uniformly (shared
+        pages decrement, private duplicates free)."""
+        if self._pcache is not None and req.pages:
+            n_full = min(n_cached // self.psz, len(req.pages))
+            k = 0
+            while k < n_full and req.pages[k] is not None:
+                k += 1
+            if k:
+                self.prefix_stats.inserted_pages += self._pcache.insert(
+                    req.context[: k * self.psz], req.pages[:k]
+                )
+        if req.prefix_node is not None:
+            self._pcache.unlock(req.prefix_node)
+            req.prefix_node = None
+        self.alloc.free([p for p in req.pages if p is not None])
+        req.pages = []
+        req.n_prefix = 0
+
     def _preempt(self, req: Request) -> None:
         """Evict an active request, returning its pages; it re-enters at the
-        head of the queue and resumes from its full context on re-prefill."""
+        head of the queue and resumes from its full context on re-prefill
+        (cheaply, when the prefix cache kept its pages)."""
         log.info("preempting request %d (pool pressure)", req.rid)
         self.preemptions += 1
         slot = req.slot
-        self.alloc.free([p for p in req.pages if p is not None])
-        req.pages = []
+        self._release_request(req, int(self.seq_lens[slot]))
         req.freed_until = 0
         req.slot = None
         self.slots[slot] = None
@@ -692,6 +928,13 @@ class InferenceEngine:
             n_need = min(last // self.psz + 1, self.pages_per_seq)
             while len(req.pages) < n_need:
                 while self.alloc.free_pages < 1:
+                    # Reclaim cached pages before touching live requests:
+                    # the prefix cache is headroom, not a tenant. (A
+                    # preemption below may DONATE pages to the cache, which
+                    # this branch then reclaims on the next iteration.)
+                    if self._pcache is not None and self._pcache.evict(1):
+                        self.prefix_stats.evicted_pages += 1
+                        continue
                     victims = [
                         r for r in by_age
                         if r.slot is not None and r is not req
@@ -806,8 +1049,10 @@ class InferenceEngine:
     def _reap(self) -> None:
         for i, req in enumerate(self.slots):
             if req is not None and req.done:
-                self.alloc.free([p for p in req.pages if p is not None])
-                req.pages = []
+                # seq_lens counts tokens whose KV is actually in the pool
+                # (decode-window overshoot lands beyond it): the full pages
+                # below it are what _release_request donates to the cache.
+                self._release_request(req, int(self.seq_lens[i]))
                 self.slots[i] = None
                 self.page_table[i] = 0
                 self.seq_lens[i] = 0

@@ -105,17 +105,35 @@ def init_cache(
 
 
 class PageAllocator:
-    """Host-side free list over the page pool (page 0 reserved as scratch)."""
+    """Host-side refcounted free list over the page pool (page 0 = scratch).
+
+    Pages are refcounted so the prefix cache (infer/prefix_cache.py) and
+    live requests can SHARE immutable pages: ``alloc`` hands out pages at
+    refcount 1, ``retain`` adds an owner, and ``release`` drops one — the
+    page returns to the free list only when its last owner lets go. The
+    single accounting invariant every owner relies on:
+
+        free_pages + sum(refcounted live pages) == num_pages - 1
+
+    where a page is live iff its refcount > 0 (owners: one per mapping in a
+    live request's page table, plus one for the radix-tree node that caches
+    it). ``free`` remains as a bulk release for owners holding exactly one
+    ref per page.
+    """
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is scratch)")
         self.num_pages = num_pages
         self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self._refs: list[int] = [0] * num_pages
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
 
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
@@ -124,9 +142,47 @@ class PageAllocator:
                 f"{len(self._free)}"
             )
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
+    def retain(self, page: int) -> None:
+        """Add an owner to a live (shared) page."""
+        assert 0 < page < self.num_pages, page
+        assert self._refs[page] > 0, f"retain of free page {page}"
+        self._refs[page] += 1
+
+    def release(self, page: int) -> bool:
+        """Drop one ownership ref; returns True iff the page was freed."""
+        assert 0 < page < self.num_pages, page
+        assert self._refs[page] > 0, f"release of free page {page}"
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
     def free(self, pages: list[int]) -> None:
+        """Bulk release for owners holding one ref per page."""
         for p in pages:
-            assert 0 < p < self.num_pages, p
-            self._free.append(p)
+            self.release(p)
+
+
+def copy_page(cache: Cache, src, dst, *, n_layers: int, num_pages: int) -> Cache:
+    """Copy one pool page's rows (all layers, all cache arrays) src -> dst.
+
+    The copy-on-write primitive behind prefix caching: when a request's
+    whole context is cached, its first decode step must (re)write the KV
+    slot of the final token — which lives in a SHARED page. The engine
+    copies that page into a private one first, so shared pages stay
+    immutable. ``src``/``dst`` may be traced scalars (one jit program
+    serves every copy); scale pools under kv_quant ride along because the
+    copy walks the whole cache dict.
+    """
+    layer_rows = jnp.arange(n_layers, dtype=jnp.int32) * num_pages
+    rows_src = layer_rows + src
+    rows_dst = layer_rows + dst
+    return {
+        name: arr.at[rows_dst].set(arr[rows_src])
+        for name, arr in cache.items()
+    }

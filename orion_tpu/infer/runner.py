@@ -110,6 +110,9 @@ def prefill_step(
     tokens: jax.Array,        # [Nb, S_pad]  (padded prompts, one bucket)
     lengths: jax.Array,       # [Nb] int32: true prompt lengths
     pages: jax.Array,         # [Nb, S_pad // page_size] int32 page ids
+    prefix_lens: Optional[jax.Array] = None,   # [Nb] int32 cached tokens
+    prefix_pages: Optional[jax.Array] = None,  # [Nb, P_pre] int32 page ids
+    *,
     cfg: ModelConfig,
     mesh: Optional[jax.sharding.Mesh] = None,
 ) -> tuple[jax.Array, Cache]:
@@ -118,6 +121,19 @@ def prefill_step(
     ``mesh`` (tensor-parallel serving) makes the flash kernel run under a
     head-sharded shard_map instead of gathering tp-sharded q/k/v; the
     dense matmuls partition from the params' shardings as usual.
+
+    Prefix caching (``prefix_pages`` with static width P_pre > 0): rows
+    start MID-SEQUENCE — ``tokens`` holds only the uncached tail,
+    positions (RoPE / learned PE) begin at each row's ``prefix_lens``, and
+    attention runs tail queries against the CACHED prefix K/V (gathered
+    from the pool pages per layer) concatenated with the tail's own K/V.
+    Explicit q/kv positions + segment ids carry the mid-sequence causal
+    structure through both kernel paths (the flash kernel's segment
+    masking skips all-padding prefix blocks for rows with shorter
+    matches). With P_pre == 0 the program is byte-identical to the
+    pre-prefix-cache prefill. The tail's page scatter is unchanged: cached
+    prefixes are page-aligned, so tail token t keeps in-page offset
+    ``t % page_size``.
 
     Returns (next-token logits [Nb, V], updated cache). Rows are independent
     sequences (separate page sets); a burst of admissions is served by a
@@ -131,27 +147,76 @@ def prefill_step(
     NP = cache["k"].shape[0] // cfg.n_layers
     n_pages = S_pad // psz
     quant = "k_scale" in cache
-    positions = jnp.broadcast_to(
-        jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
-    )
-    # Ragged burst: rows shorter than the bucket mark their padding tail
-    # with segment id 0 — the flash kernel SKIPS all-padding blocks, so a
-    # mixed-length admission burst pays per-row actual-length compute in
-    # one dispatch instead of bucket-padded compute per bucket.
-    seg = (positions < lengths[:, None]).astype(jnp.int32)
+    P_pre = 0 if prefix_pages is None else prefix_pages.shape[1]
+    if P_pre:
+        positions = prefix_lens[:, None] + jnp.arange(S_pad, dtype=jnp.int32)
+        pre_idx = jnp.arange(P_pre * psz, dtype=jnp.int32)
+        # Prefix kv positions are absolute [0, P_pre*psz); columns past a
+        # row's own prefix are garbage -> segment id 0 (and, under SWA,
+        # behind the window anyway for pages the engine mapped to scratch).
+        kv_pos = jnp.concatenate(
+            [jnp.broadcast_to(pre_idx[None], (Nb, P_pre * psz)), positions],
+            axis=1,
+        )
+        seg = (
+            jnp.arange(S_pad, dtype=jnp.int32)[None] < lengths[:, None]
+        ).astype(jnp.int32)
+        kv_seg = jnp.concatenate(
+            [(pre_idx[None] < prefix_lens[:, None]).astype(jnp.int32), seg],
+            axis=1,
+        )
+    else:
+        positions = jnp.broadcast_to(
+            jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
+        )
+        # Ragged burst: rows shorter than the bucket mark their padding tail
+        # with segment id 0 — the flash kernel SKIPS all-padding blocks, so a
+        # mixed-length admission burst pays per-row actual-length compute in
+        # one dispatch instead of bucket-padded compute per bucket.
+        seg = (positions < lengths[:, None]).astype(jnp.int32)
 
     def body(carry, bp, l, j):
         x, cc = carry
         h = _norm(x, bp["attn_norm"], cfg)
         q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
-        out = attention(
-            q, k, v, causal=True,
-            q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
-            logit_softcap=cfg.attn_logit_softcap,
-            window=cfg.layer_window(j),
-            block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
-            impl=cfg.kernels, mesh=mesh,
-        )
+        if P_pre:
+            # Gather this layer's cached prefix K/V pages from the pool
+            # and attend tail queries over prefix + tail. [Nb, P_pre] page
+            # rows -> [Nb, P_pre*psz, K, H] (heads-major pages).
+            Kh, Hd = k.shape[2], k.shape[3]
+            rows_pre = l * NP + prefix_pages
+            k_pre = cc["k"][rows_pre].transpose(0, 1, 3, 2, 4)
+            v_pre = cc["v"][rows_pre].transpose(0, 1, 3, 2, 4)
+            if quant:
+                ksc = cc["k_scale"][rows_pre][..., :psz]   # [Nb,P,K,psz]
+                vsc = cc["v_scale"][rows_pre][..., :psz]
+                k_pre = k_pre.astype(jnp.float32) * ksc.transpose(
+                    0, 1, 3, 2)[..., None]
+                v_pre = v_pre.astype(jnp.float32) * vsc.transpose(
+                    0, 1, 3, 2)[..., None]
+            k_pre = k_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(k.dtype)
+            v_pre = v_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(v.dtype)
+            out = attention(
+                q,
+                jnp.concatenate([k_pre, k], axis=1),
+                jnp.concatenate([v_pre, v], axis=1),
+                causal=True,
+                q_segment_ids=seg, kv_segment_ids=kv_seg, seg_pad_zero=True,
+                q_positions=positions, kv_positions=kv_pos,
+                logit_softcap=cfg.attn_logit_softcap,
+                window=cfg.layer_window(j),
+                block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+                impl=cfg.kernels, mesh=mesh,
+            )
+        else:
+            out = attention(
+                q, k, v, causal=True,
+                q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
+                logit_softcap=cfg.attn_logit_softcap,
+                window=cfg.layer_window(j),
+                block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+                impl=cfg.kernels, mesh=mesh,
+            )
         a = out_proj(out, bp["attn"], cfg)
         if cfg.post_norms:
             a = _norm(a, bp["post_attn_norm"], cfg)

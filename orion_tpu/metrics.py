@@ -70,6 +70,42 @@ class StepMetrics:
         return d
 
 
+@dataclass
+class PrefixCacheStats:
+    """Serving-side prefix-cache counters (SURVEY.md §6 metrics).
+
+    Owned by InferenceEngine and surfaced through ``reset_timing`` (the
+    serving metrics drain point, like the device/host split): hits/misses
+    count admissions, cached_tokens the prompt tokens served from shared
+    pages instead of prefill FLOPs, evicted/inserted/cow pages the pool
+    churn the cache itself causes.
+    """
+
+    hits: int = 0
+    misses: int = 0
+    cached_tokens: int = 0
+    inserted_pages: int = 0
+    evicted_pages: int = 0
+    cow_pages: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def as_timing(self) -> dict[str, float]:
+        """Flatten into the engine's reset_timing dict."""
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_hit_rate": self.hit_rate,
+            "cached_tokens": self.cached_tokens,
+            "inserted_pages": self.inserted_pages,
+            "evicted_pages": self.evicted_pages,
+            "cow_pages": self.cow_pages,
+        }
+
+
 class MetricsLogger:
     """Accumulates per-step metrics; writes console lines and optional JSONL."""
 

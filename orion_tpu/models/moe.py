@@ -35,7 +35,12 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import AxisType, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.5: explicit mesh axis types (Manual detection under pp)
+    from jax.sharding import AxisType
+except ImportError:  # older jax: no Manual-mesh context to detect
+    AxisType = None
 
 from orion_tpu.config import ModelConfig
 
@@ -293,8 +298,10 @@ def moe_mlp_sorted_a2a(
     # that context, so sorted_a2a composes with pp (r4 restriction lifted,
     # round 5); per-microbatch token slices only shrink C_loc, the same
     # per-slice drop semantics as any batch sharding.
-    ctx = jax.sharding.get_abstract_mesh()
-    if any(t == AxisType.Manual for t in getattr(ctx, "axis_types", ())):
+    ctx = getattr(jax.sharding, "get_abstract_mesh", lambda: None)()
+    if AxisType is not None and ctx is not None and any(
+        t == AxisType.Manual for t in getattr(ctx, "axis_types", ())
+    ):
         mesh = ctx
     E = cfg.n_experts
     if E % ep:

@@ -71,6 +71,28 @@ from jax.sharding import Mesh, PartitionSpec as P
 BlockFn = Callable[[jax.Array, Any], Tuple[jax.Array, jax.Array]]
 
 
+def validate_row_state(row_state: Any, batch: int, num_microbatches: int):
+    """Normalize per-row state for microbatch slicing (ADVICE r5).
+
+    The non-pp block_fn accepts row-state leaves with a broadcast [1, ...]
+    leading dim; pipelining slices leaves to [M, B/M, ...], so lift the
+    broadcast to the full batch up front and reject any other leading dim
+    loudly instead of dying in an opaque reshape."""
+    def _leaf(a):
+        a = jnp.asarray(a)
+        if a.ndim >= 1 and a.shape[0] == 1 and batch != 1:
+            return jnp.broadcast_to(a, (batch,) + a.shape[1:])
+        if a.ndim < 1 or a.shape[0] != batch:
+            raise ValueError(
+                f"pipeline row_state leaf has shape {a.shape}: leading dim "
+                f"must equal the batch ({batch}) — or 1 to broadcast — so "
+                f"it can be sliced into {num_microbatches} microbatches"
+            )
+        return a
+
+    return jax.tree.map(_leaf, row_state)
+
+
 def pipeline_forward(
     x: jax.Array,                 # [B, S, D] (batch auto-sharded on dp/fsdp)
     blocks: Any,                  # stacked per-layer params, leaves [L, ...]
@@ -121,6 +143,8 @@ def pipeline_forward(
     L = jax.tree.leaves(blocks)[0].shape[0]
     if L % pp:
         raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+
+    row_state = validate_row_state(row_state, B, M)
     rs_mb = jax.tree.map(
         lambda a: a.reshape(M, B // M, *a.shape[1:]), row_state
     )

@@ -21,6 +21,8 @@ true-corruption tests that monkeypatch router outputs.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
@@ -32,7 +34,18 @@ class DeviceAssertionError(AssertionError):
 
 
 def inject(site: str) -> None:
-    """Force the named assert site to fail (test hook)."""
+    """Force the named assert site to fail (test hook).
+
+    TRACE-TIME ONLY: the injection is read while the enclosing program is
+    being traced (``if site in _INJECTED`` inside device_assert runs at
+    trace time), so it only takes effect for programs compiled AFTER this
+    call. Injecting after a jit cache is warm (the program already
+    compiled) is a silent no-op — tests must inject BEFORE the first call
+    of the program under test, or clear the jit cache. This is by design:
+    the hook validates that an assert is actually wired into a given
+    layout's compiled program, not that a cached program re-reads host
+    state.
+    """
     _INJECTED.add(site)
 
 
@@ -40,7 +53,11 @@ def clear_injected() -> None:
     _INJECTED.clear()
 
 
+# Failure records appended by the (async) debug-callback thread and drained
+# by raise_if_failed on the scheduler thread — guarded by a lock so a
+# failure landing mid-drain is never dropped.
 _failures: list[str] = []
+_failures_lock = threading.Lock()
 
 
 def device_assert(enabled: bool, pred: jax.Array, site: str, msg: str) -> None:
@@ -62,7 +79,8 @@ def device_assert(enabled: bool, pred: jax.Array, site: str, msg: str) -> None:
     def _check(ok, _site=site, _msg=msg):
         if not bool(ok):
             rec = f"device_assert[{_site}]: {_msg}"
-            _failures.append(rec)
+            with _failures_lock:
+                _failures.append(rec)
             import logging
 
             logging.getLogger("orion_tpu.asserts").error(rec)
@@ -73,8 +91,13 @@ def device_assert(enabled: bool, pred: jax.Array, site: str, msg: str) -> None:
 def raise_if_failed() -> None:
     """Raise DeviceAssertionError if any device_assert has fired since the
     last call. Call sites: Trainer.train_step / InferenceEngine.step (the
-    per-step host sync points). Drains the record either way."""
-    if _failures:
+    per-step host sync points). Drains the record either way — the swap
+    happens atomically under the callback lock, so a failure appended by
+    the async callback thread between snapshot and clear can't be lost
+    (ADVICE r5)."""
+    with _failures_lock:
+        if not _failures:
+            return
         recs = list(_failures)
         _failures.clear()
-        raise DeviceAssertionError("; ".join(recs))
+    raise DeviceAssertionError("; ".join(recs))

@@ -22,6 +22,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (after XLA_FLAGS)
 import pytest  # noqa: E402
 
+import orion_tpu  # noqa: E402,F401  (installs the jax.shard_map compat
+#                   shim BEFORE test modules do `from jax import shard_map`)
+
 # Tests are CPU-only (fake multi-device mesh). Force the platform *before*
 # any backend initialization: the axon TPU plugin registered by the
 # machine's sitecustomize hangs jax.devices() whenever its tunnel is down,
@@ -62,3 +65,11 @@ def make_mesh(cpu_devices, **axes):
 
     cfg = ParallelConfig(**axes)
     return build_mesh(cfg, devices=cpu_devices[: cfg.num_devices])
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (see ROADMAP.md); heavy cases "
+        "and files that exceed the 870s CPU budget run in the full tier",
+    )

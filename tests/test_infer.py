@@ -92,6 +92,7 @@ def test_gemma2_engine_softcap_regime():
     assert out == ref
 
 
+@pytest.mark.slow  # 24 full-forward reference decodes, ~40s on the CPU tier
 def test_gemma2_engine_beyond_window():
     """Gemma-2 serving past the sliding window: local layers mask to the
     last W positions while global layers read the whole history (pages
@@ -710,6 +711,7 @@ def test_sliding_window_engine_matches_forward(kernels):
     assert out == ref
 
 
+@pytest.mark.slow  # 90-token SWA generation, ~80s on the CPU tier
 def test_rolling_window_bounds_page_footprint():
     """SWA serving is O(window) in pages: a pool too small for the full
     context (old behavior: single-request MemoryError) serves a long

@@ -1,0 +1,334 @@
+"""Automatic prefix caching (infer/prefix_cache.py + engine integration).
+
+The load-bearing property is EQUIVALENCE: with inference.prefix_cache on,
+served tokens must be byte-identical to the cache-off engine's, across
+greedy and sampled decoding, sliding-window models, preemption under pool
+pressure, and max_new_tokens=0 scoring — everywhere the page table is
+written. Plus the acceptance check: a warm repeat of a shared-prefix batch
+performs ZERO prefill work for the cached pages (prefill_s / cached-token
+counters), and the radix tree's refcount/lock/LRU mechanics hold on their
+own.
+"""
+
+import jax
+import pytest
+
+from orion_tpu.config import get_config
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.infer.kv_cache import PageAllocator
+from orion_tpu.infer.prefix_cache import PrefixCache
+from orion_tpu.models import init_params
+
+INFER_OVERRIDES = [
+    "inference.max_seq_len=128",
+    "inference.page_size=16",
+    "inference.num_pages=32",
+    "inference.max_batch_size=4",
+    "inference.prefill_chunk=16",
+    "inference.max_new_tokens=8",
+]
+
+
+def _setup(preset="tiny-llama", overrides=(), cache=True):
+    ov = list(INFER_OVERRIDES)
+    if cache:
+        ov.append("inference.prefix_cache=true")
+    cfg = get_config(preset, ov + list(overrides))
+    params = init_params(cfg.model, jax.random.key(0))
+    return cfg, params
+
+
+# -- radix tree unit tests ---------------------------------------------------
+
+
+def test_radix_insert_match_dedup_refcounts():
+    alloc = PageAllocator(64)
+    pc = PrefixCache(4, alloc)
+    toks = list(range(12))                     # 3 pages of 4 tokens
+    pages = alloc.alloc(3)
+    assert pc.insert(toks, pages) == 3         # tree retains: refcount 2
+    assert all(alloc.refcount(p) == 2 for p in pages)
+    alloc.free(pages)                          # caller drops its refs
+    assert all(alloc.refcount(p) == 1 for p in pages)
+    assert pc.total_pages == 3
+
+    got, node = pc.match(toks + [99], max_pages=8)
+    assert got == pages and node is not None
+    assert pc.evict(10) == 0                   # locked path: nothing evictable
+    assert pc.evictable_pages() == 0
+    pc.unlock(node)
+    assert pc.evictable_pages() == 3
+
+    # Duplicate insert keeps the existing pages; the caller's copies free.
+    dup = alloc.alloc(3)
+    assert pc.insert(toks, dup) == 0
+    alloc.free(dup)
+    assert all(alloc.refcount(p) == 0 for p in dup)
+
+    # Page-granular match cap, and partial-edge SPLIT on a diverging branch.
+    got2, node2 = pc.match(toks, max_pages=2)
+    assert got2 == pages[:2]
+    pc.unlock(node2)
+    branch = toks[:8] + [70, 71, 72, 73]
+    bp = alloc.alloc(3)
+    assert pc.insert(branch, bp) == 1          # shares 2 pages, adds 1
+    alloc.free(bp)
+    got3, node3 = pc.match(branch + [5], max_pages=8)
+    assert got3 == pages[:2] + [bp[2]]
+    pc.unlock(node3)
+
+
+def test_radix_lru_page_granular_eviction():
+    alloc = PageAllocator(64)
+    pc = PrefixCache(4, alloc)
+    a_pages, b_pages = alloc.alloc(2), alloc.alloc(2)
+    pc.insert([1] * 8, a_pages)
+    pc.insert([2] * 8, b_pages)
+    alloc.free(a_pages)
+    alloc.free(b_pages)
+    # Touch A -> B becomes LRU; eviction trims B's TRAILING page first.
+    _, na = pc.match([1] * 8 + [9], max_pages=8)
+    pc.unlock(na)
+    assert pc.evict(1) == 1
+    assert alloc.refcount(b_pages[1]) == 0     # trailing B page freed
+    assert alloc.refcount(b_pages[0]) == 1
+    got, nb = pc.match([2] * 8, max_pages=8)
+    assert got == b_pages[:1]                  # head of B survives
+    pc.unlock(nb)
+    assert pc.evict(99) == 3                   # drains the rest
+    assert alloc.free_pages == 63
+    assert pc.total_pages == 0
+
+
+# -- engine equivalence ------------------------------------------------------
+
+
+def test_prefix_cache_default_off():
+    cfg, params = _setup(cache=False)
+    assert cfg.inference.prefix_cache is False
+    eng = InferenceEngine(cfg, params)
+    assert eng._pcache is None
+    assert "prefix_hits" not in eng.reset_timing()
+
+
+def test_equivalence_greedy_and_mixed_hit_miss():
+    """Two rounds of shared-prefix traffic: cache-on tokens byte-identical
+    to cache-off, warm round hits the cache, and a fresh prompt in the warm
+    round (cold row in the same prefill dispatch) is served unchanged."""
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(cache=False)
+    prompts = [[(i * 7) % 250 + 1 for i in range(21)],
+               list(range(2, 32)),
+               [7] * 18]
+    eng_on = InferenceEngine(cfg_on, params)
+    eng_off = InferenceEngine(cfg_off, params)
+    assert eng_on.generate(prompts, 6) == eng_off.generate(prompts, 6)
+    eng_on.reset_timing()
+    mixed = [prompts[0], [99, 98, 97] * 7, prompts[2]]   # hit, miss, hit
+    assert eng_on.generate(mixed, 6) == eng_off.generate(mixed, 6)
+    t = eng_on.reset_timing()
+    assert t["prefix_hits"] >= 2, t
+    assert t["prefix_misses"] >= 1, t
+    assert t["cached_tokens"] >= 32, t
+    assert 0 < t["prefix_hit_rate"] < 1
+
+
+def test_warm_repeat_zero_prefill_flops():
+    """Acceptance: a warm repeat of page-multiple prompts matches its WHOLE
+    context — no prefill dispatch at all (prefill_s == 0), first token
+    re-derived by decode off a copy-on-write page — with byte-identical
+    tokens to the cache-off engine."""
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(cache=False)
+    prompts = [list(range(1, 33)), [9, 8, 7, 6] * 4]     # 32 and 16 tokens
+    eng = InferenceEngine(cfg_on, params)
+    cold = eng.generate(prompts, 6)
+    eng.reset_timing()
+    warm = eng.generate(prompts, 6)
+    t = eng.reset_timing()
+    assert warm == cold
+    assert warm == InferenceEngine(cfg_off, params).generate(prompts, 6)
+    assert t["prefill_s"] == 0.0, t          # zero prefill work performed
+    assert t["prefix_hits"] == 2, t
+    assert t["cached_tokens"] == 31 + 15, t  # all but the re-derived token
+    assert t["cow_pages"] == 2, t
+
+
+def test_equivalence_sampled():
+    """Sampled decoding (nonzero temperature): the cache must not perturb
+    the PRNG key stream, so cache on/off produce identical samples."""
+    sampled = ["inference.temperature=0.9", "inference.top_k=40"]
+    cfg_on, params = _setup(overrides=sampled)
+    cfg_off, _ = _setup(overrides=sampled, cache=False)
+    prompts = [[(i * 11) % 250 + 1 for i in range(21)],
+               [(i * 5) % 250 + 1 for i in range(18)]]
+    eng_on = InferenceEngine(cfg_on, params, seed=7)
+    eng_off = InferenceEngine(cfg_off, params, seed=7)
+    for _ in range(2):                        # cold round, then warm round
+        assert eng_on.generate(prompts, 6) == eng_off.generate(prompts, 6)
+    assert eng_on.reset_timing()["prefix_hits"] >= 2
+
+
+def test_equivalence_sampled_full_match_falls_back():
+    """A SAMPLED request whose whole context is cached must NOT take the
+    zero-prefill COW path (its first token would come from the decode key
+    stream where the cold engine uses the prefill stream): it falls back
+    to a one-page tail prefill, keeping the PRNG streams aligned and the
+    sampled tokens byte-identical."""
+    sampled = ["inference.temperature=0.8"]
+    cfg_on, params = _setup(overrides=sampled)
+    cfg_off, _ = _setup(overrides=sampled, cache=False)
+    prompts = [list(range(1, 33))]                       # exact page multiple
+    eng_on = InferenceEngine(cfg_on, params, seed=3)
+    eng_off = InferenceEngine(cfg_off, params, seed=3)
+    for _ in range(2):
+        assert eng_on.generate(prompts, 6) == eng_off.generate(prompts, 6)
+    t = eng_on.reset_timing()
+    assert t["prefix_hits"] >= 1, t
+    assert t["cow_pages"] == 0, t        # gate held: no zero-prefill path
+
+
+def test_equivalence_sliding_window():
+    """SWA: the warm tail prefill READS cached prefix pages under the
+    window mask (cold prefill never reads pages) — tokens must still equal
+    the cache-off engine's past the window."""
+    swa = ["model.sliding_window=20"]
+    cfg_on, params = _setup(overrides=swa)
+    cfg_off, _ = _setup(overrides=swa, cache=False)
+    prompts = [[(i * 13) % 250 + 1 for i in range(21)]]
+    eng_on = InferenceEngine(cfg_on, params)
+    eng_off = InferenceEngine(cfg_off, params)
+    for _ in range(2):
+        assert eng_on.generate(prompts, 12) == eng_off.generate(prompts, 12)
+    assert eng_on.reset_timing()["prefix_hits"] >= 1
+
+
+def test_equivalence_preemption_under_pressure():
+    """Pool pressure with the cache competing for pages: eviction feeds
+    allocation, preemption donates pages back, re-admission re-matches
+    them — and every request's tokens still equal single-request serving."""
+    cfg_on, params = _setup(overrides=["inference.num_pages=8"])
+    cfg_off, _ = _setup(overrides=["inference.num_pages=8"], cache=False)
+    prompts = [[5, 3, 9, 250, 17, 8, 100, 42, 77, 31, 2, 6, 90, 55, 21],
+               [7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53, 59, 61]]
+    singles = [
+        InferenceEngine(cfg_off, params).generate([p], 50)[0]
+        for p in prompts
+    ]
+    eng = InferenceEngine(cfg_on, params)
+    assert eng.generate(prompts, 50) == singles
+    assert eng.preemptions > 0, "scenario failed to exercise preemption"
+
+
+def test_scoring_requests_warm_and_hit_the_cache():
+    """max_new_tokens=0 scoring requests both populate and consume the
+    cache — including the full-match path, where a repeat scoring request
+    does no compute at all."""
+    cfg_on, params = _setup()
+    eng = InferenceEngine(cfg_on, params)
+    p_part, p_full = [3] * 20, list(range(1, 33))        # 20 and 32 tokens
+    assert eng.generate([p_part, p_full], 0) == [[], []]
+    eng.reset_timing()
+    assert eng.generate([p_part, p_full], 0) == [[], []]
+    t = eng.reset_timing()
+    assert t["prefix_hits"] == 2, t
+    assert t["cached_tokens"] >= 16 + 31, t
+    # And a scoring-warmed prefix serves a real generation identically.
+    cfg_off, _ = _setup(cache=False)
+    assert eng.generate([p_part], 6) == (
+        InferenceEngine(cfg_off, params).generate([p_part], 6)
+    )
+
+
+def test_pool_accounting_invariant():
+    """One pool, one invariant: free + tree-cached == usable pages whenever
+    no request is live, every cached page at refcount 1; a full eviction
+    returns the pool to pristine."""
+    cfg_on, params = _setup()
+    eng = InferenceEngine(cfg_on, params)
+    eng.generate([[5, 3, 9] * 7, list(range(40)), [8] * 17], 6)
+    usable = cfg_on.inference.num_pages - 1
+    pc = eng._pcache
+    assert pc.total_pages > 0
+    assert eng.alloc.free_pages + pc.total_pages == usable
+    for node in pc._walk():
+        assert node.lock == 0
+        for p in node.pages:
+            assert eng.alloc.refcount(p) == 1
+    cached = pc.total_pages
+    assert pc.evict(10 ** 6) == cached       # fully drainable when idle
+    assert pc.total_pages == 0
+    assert eng.alloc.free_pages == usable
+
+
+def test_kv_int8_prefix_cache_smoke():
+    """prefix_cache composes with kv_quant=int8: the warm tail prefill
+    reads DEQUANTIZED prefix pages (decode's view of the cache), so warm
+    logits see quantized prefix KV where a cold prefill sees unquantized
+    activations — byte-identity is not promised, but serving must run,
+    hit, and keep the greedy stream aligned with the cache-off int8
+    engine on the cold round."""
+    ov = ["inference.kv_quant=int8"]
+    cfg_on, params = _setup(overrides=ov)
+    cfg_off, _ = _setup(overrides=ov, cache=False)
+    prompts = [[(i * 9) % 250 + 1 for i in range(21)]]
+    eng_on = InferenceEngine(cfg_on, params)
+    eng_off = InferenceEngine(cfg_off, params)
+    assert eng_on.generate(prompts, 6) == eng_off.generate(prompts, 6)
+    warm = eng_on.generate(prompts, 6)
+    assert len(warm[0]) == 6
+    assert all(0 <= t < cfg_on.model.vocab_size for t in warm[0])
+    assert eng_on.reset_timing()["prefix_hits"] >= 1
+
+
+def test_equivalence_tp_sharded_pallas(cpu_devices):
+    """Prefix cache x tp-sharded KV pool x Pallas serving: the warm
+    prefill's prefix gather and the COW page copy run on the head-sharded
+    pool; tokens equal the unsharded cache-off engine's across rounds."""
+    import dataclasses
+
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.models.transformer import param_logical_axes
+    from orion_tpu.parallel.sharding import param_shardings
+    from orion_tpu.runtime import build_mesh
+
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(cache=False)
+    pcfg_on = dataclasses.replace(
+        cfg_on, model=dataclasses.replace(cfg_on.model,
+                                          kernels="pallas_interpret")
+    )
+    pcfg_off = dataclasses.replace(
+        cfg_off, model=dataclasses.replace(cfg_off.model,
+                                           kernels="pallas_interpret")
+    )
+    prompts = [[(i * 7) % 250 + 1 for i in range(21)], list(range(1, 33))]
+    eng_ref = InferenceEngine(pcfg_off, params)
+    ref = [eng_ref.generate(prompts, 5) for _ in range(2)]
+
+    mesh = build_mesh(ParallelConfig(tp=2), devices=cpu_devices[:2])
+    shardings = param_shardings(mesh, param_logical_axes(cfg_on.model))
+    sharded = jax.device_put(params, shardings)
+    eng = InferenceEngine(pcfg_on, sharded)
+    assert eng.mesh is not None
+    got = [eng.generate(prompts, 5) for _ in range(2)]
+    assert got == ref
+    t = eng.reset_timing()
+    assert t["prefix_hits"] >= 2, t
+    assert t["cow_pages"] >= 1, t              # 32-token prompt: full match
+
+
+@pytest.mark.parametrize("kernels", ["xla", "pallas_interpret"])
+def test_equivalence_across_kernel_paths(kernels):
+    """The warm mid-sequence prefill (explicit positions + segment ids)
+    must hold on BOTH kernel paths: two rounds on each path, cache on vs
+    off, byte-identical."""
+    ov = [f"model.kernels={kernels}"]
+    cfg_on, params = _setup(overrides=ov)
+    cfg_off, _ = _setup(overrides=ov, cache=False)
+    prompts = [[(i * 3) % 250 + 1 for i in range(19)]]
+    eng_on = InferenceEngine(cfg_on, params)
+    eng_off = InferenceEngine(cfg_off, params)
+    for _ in range(2):
+        assert eng_on.generate(prompts, 5) == eng_off.generate(prompts, 5)
+    assert eng_on.reset_timing()["prefix_hits"] >= 1

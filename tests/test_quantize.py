@@ -14,6 +14,12 @@ from orion_tpu.models.quantize import (
     quantize_weight,
 )
 
+# Revived on jax-0.4.37 boxes by the round-6 compat shims (previously a
+# collection error), but too heavy for the tier-1 CPU budget — the serving
+# stack (test_infer / test_prefix_cache) owns that budget this round. Runs
+# in the full tier (no `-m "not slow"`).
+pytestmark = pytest.mark.slow
+
 
 def test_quantize_weight_roundtrip_error_bound():
     w = jax.random.normal(jax.random.key(0), (64, 32)) * jnp.exp(

@@ -16,6 +16,13 @@ from orion_tpu.config import get_config
 from orion_tpu.train import Trainer
 from orion_tpu.train.trainer import FaultInjected
 
+# Revived on jax-0.4.37 boxes by the round-6 compat shims (previously a
+# collection error), but too heavy for the tier-1 CPU budget — the serving
+# stack (test_infer / test_prefix_cache) owns that budget this round. Runs
+# in the full tier (no `-m "not slow"`).
+pytestmark = pytest.mark.slow
+
+
 
 def _cfg(tmp_path=None, preset="tiny", extra=()):
     over = ["runtime.platform=cpu", "train.num_steps=60",
