@@ -41,6 +41,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="top-k sampling filter (0 disables)")
     parser.add_argument("--top-p", type=float, default=None,
                         help="nucleus sampling threshold in (0, 1]")
+    parser.add_argument("--chunked-prefill", action="store_true",
+                        help="bound decode stalls under prompt bursts: "
+                             "split prompt prefill into page-aligned "
+                             "chunks mixed into each decode step; sugar "
+                             "for inference.chunked_prefill=true (budget "
+                             "via inference.prefill_chunk_tokens=N)")
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides"
     )
@@ -68,6 +74,8 @@ def main(argv: list[str] | None = None) -> int:
                       (args.top_p, "inference.top_p")):
         if flag is not None:
             overrides.append(f"{key}={flag}")
+    if args.chunked_prefill:
+        overrides.append("inference.chunked_prefill=true")
     cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 

@@ -433,6 +433,24 @@ class InferenceConfig:
     # (mapping a 1-page prefix costs table/refcount churn for little gain
     # when page_size is small).
     prefix_cache_min_pages: int = 1
+    # Chunked prefill (Sarathi-style stall-free batching): admission no
+    # longer prefills whole prompts eagerly — pending prompts split at page
+    # granularity into chunks of at most prefill_chunk_tokens, and every
+    # engine step with prompt work in flight runs ONE unified mixed
+    # dispatch (runner.mixed_step): a single-token decode for every live
+    # slot fused with up to the budget of prompt-tail tokens. Bounds the
+    # inter-token latency a decode can observe under a long-prompt burst
+    # by the chunk budget (max stall ~ chunk_tokens x per-token prefill
+    # cost, see PERF.md "Chunked prefill") instead of the whole quadratic
+    # prompt, and lets bandwidth-bound decode share the chip with
+    # compute-bound prefill. Off by default: pure-throughput batch
+    # workloads with no latency SLO prefer whole-prompt prefill.
+    chunked_prefill: bool = False
+    # Per-step prompt-token budget for chunked prefill. Must be a positive
+    # multiple of page_size (chunks split at page granularity so every
+    # resumed chunk starts page-aligned, reusing the prefix-cache
+    # mid-sequence prefill path unchanged).
+    prefill_chunk_tokens: int = 256
 
 
 @dataclass(frozen=True)

@@ -4,12 +4,16 @@ TPU-native equivalent of the reference's ``inference/generate.py`` with
 continuous batching (BASELINE.json:11; SURVEY.md §4 stack B): a fixed-size
 paged KV-cache pool keeps every device shape static for XLA, prefill and
 decode are separate jit programs, and a host-side admission/scheduler loop
-streams requests in and tokens out.
+streams requests in and tokens out. With ``inference.chunked_prefill`` the
+two programs fuse into a third: ``runner.mixed_step`` runs one decode
+token per live slot plus a bounded prompt chunk per dispatch, so a prompt
+burst can never stall in-flight decodes by more than the chunk budget.
 """
 
 from orion_tpu.infer.engine import InferenceEngine, Request
 from orion_tpu.infer.kv_cache import PageAllocator, init_cache
 from orion_tpu.infer.prefix_cache import PrefixCache
+from orion_tpu.infer.runner import decode_window, mixed_step, prefill_step
 from orion_tpu.infer.sampling import sample
 
 __all__ = [
@@ -17,6 +21,9 @@ __all__ = [
     "Request",
     "PageAllocator",
     "PrefixCache",
+    "decode_window",
     "init_cache",
+    "mixed_step",
+    "prefill_step",
     "sample",
 ]

@@ -104,6 +104,166 @@ def _scan_layers(params: Params, cfg: ModelConfig, body, init_carry):
     return carry
 
 
+def _prefill_ctx(
+    cache: Cache,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    pages: jax.Array,
+    prefix_lens: Optional[jax.Array],
+    prefix_pages: Optional[jax.Array],
+    cfg: ModelConfig,
+) -> dict:
+    """Batch-level tensors the per-layer prefill body consumes (positions,
+    segment ids, page arithmetic). Shared by whole-prompt prefill, the
+    prefix-cache tail prefill, and the chunked-prefill rows of a mixed
+    step — a prefill CHUNK is exactly a mid-sequence tail prefill that
+    resumes at a page-aligned ``prefix_lens`` over already-written pages."""
+    Nb, S_pad = tokens.shape
+    psz = cache["k"].shape[2]
+    NP = cache["k"].shape[0] // cfg.n_layers
+    quant = "k_scale" in cache
+    P_pre = 0 if prefix_pages is None else prefix_pages.shape[1]
+    kv_pos = kv_seg = None
+    if P_pre:
+        positions = prefix_lens[:, None] + jnp.arange(S_pad, dtype=jnp.int32)
+        pre_idx = jnp.arange(P_pre * psz, dtype=jnp.int32)
+        # Prefix kv positions are absolute [0, P_pre*psz); columns past a
+        # row's own prefix are garbage -> segment id 0 (and, under SWA,
+        # behind the window anyway for pages the engine mapped to scratch).
+        kv_pos = jnp.concatenate(
+            [jnp.broadcast_to(pre_idx[None], (Nb, P_pre * psz)), positions],
+            axis=1,
+        )
+        seg = (
+            jnp.arange(S_pad, dtype=jnp.int32)[None] < lengths[:, None]
+        ).astype(jnp.int32)
+        kv_seg = jnp.concatenate(
+            [(pre_idx[None] < prefix_lens[:, None]).astype(jnp.int32), seg],
+            axis=1,
+        )
+    else:
+        positions = jnp.broadcast_to(
+            jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
+        )
+        # Ragged burst: rows shorter than the bucket mark their padding tail
+        # with segment id 0 — the flash kernel SKIPS all-padding blocks, so a
+        # mixed-length admission burst pays per-row actual-length compute in
+        # one dispatch instead of bucket-padded compute per bucket.
+        seg = (positions < lengths[:, None]).astype(jnp.int32)
+    return dict(
+        Nb=Nb, S_pad=S_pad, psz=psz, NP=NP, n_pages=S_pad // psz,
+        quant=quant, P_pre=P_pre, positions=positions, seg=seg,
+        kv_pos=kv_pos, kv_seg=kv_seg, pages=pages,
+        prefix_pages=prefix_pages,
+    )
+
+
+def _prefill_layer(
+    x: jax.Array,
+    cc: Cache,
+    bp: Any,
+    l,
+    j: int,
+    ctx: dict,
+    cfg: ModelConfig,
+    mesh: Optional[jax.sharding.Mesh],
+) -> tuple[jax.Array, Cache]:
+    """One transformer layer of (possibly mid-sequence) prefill: flash/xla
+    attention over [gathered prefix pages + own K/V], then scatter the new
+    K/V pages into the carried pool."""
+    Nb, psz, NP = ctx["Nb"], ctx["psz"], ctx["NP"]
+    n_pages, quant, P_pre = ctx["n_pages"], ctx["quant"], ctx["P_pre"]
+    positions, seg = ctx["positions"], ctx["seg"]
+    h = _norm(x, bp["attn_norm"], cfg)
+    q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
+    if P_pre:
+        # Gather this layer's cached prefix K/V pages from the pool
+        # and attend tail queries over prefix + tail. [Nb, P_pre] page
+        # rows -> [Nb, P_pre*psz, K, H] (heads-major pages).
+        Kh, Hd = k.shape[2], k.shape[3]
+        rows_pre = l * NP + ctx["prefix_pages"]
+        k_pre = cc["k"][rows_pre].transpose(0, 1, 3, 2, 4)
+        v_pre = cc["v"][rows_pre].transpose(0, 1, 3, 2, 4)
+        if quant:
+            ksc = cc["k_scale"][rows_pre][..., :psz]   # [Nb,P,K,psz]
+            vsc = cc["v_scale"][rows_pre][..., :psz]
+            k_pre = k_pre.astype(jnp.float32) * ksc.transpose(
+                0, 1, 3, 2)[..., None]
+            v_pre = v_pre.astype(jnp.float32) * vsc.transpose(
+                0, 1, 3, 2)[..., None]
+        k_pre = k_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(k.dtype)
+        v_pre = v_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(v.dtype)
+        out = attention(
+            q,
+            jnp.concatenate([k_pre, k], axis=1),
+            jnp.concatenate([v_pre, v], axis=1),
+            causal=True,
+            q_segment_ids=seg, kv_segment_ids=ctx["kv_seg"],
+            seg_pad_zero=True,
+            q_positions=positions, kv_positions=ctx["kv_pos"],
+            logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.layer_window(j),
+            block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+            impl=cfg.kernels, mesh=mesh,
+        )
+    else:
+        out = attention(
+            q, k, v, causal=True,
+            q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.layer_window(j),
+            block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+            impl=cfg.kernels, mesh=mesh,
+        )
+    a = out_proj(out, bp["attn"], cfg)
+    if cfg.post_norms:
+        a = _norm(a, bp["post_attn_norm"], cfg)
+    x = x + a
+    h2 = _norm(x, bp["mlp_norm"], cfg)
+    y, _ = mlp_or_moe(h2, bp, cfg)
+    if cfg.post_norms:
+        y = _norm(y, bp["post_mlp_norm"], cfg)
+    x = x + y
+    # Scatter this layer's K/V pages into the pool (in-place on the
+    # carried flat pool). Positions beyond each row's `length` hold
+    # garbage from the padding — decode masks them out via seq_lens,
+    # and the next real token overwrites its slot.
+    K, H = k.shape[2], k.shape[3]
+    rows = l * NP + ctx["pages"]                 # [Nb, n_pages]
+    cc = dict(cc)
+    if quant:
+        from orion_tpu.infer.kv_cache import quantize_kv
+
+        # Per (token, head) int8 + f32 scale; scale pages land in the
+        # first psz columns of the lanes-padded scale pool rows.
+        k, ks = quantize_kv(k)               # [Nb,S,K,H] i8, [Nb,S,K]
+        v, vs = quantize_kv(v)
+        kspg = ks.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
+        vspg = vs.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
+        cc["k_scale"] = cc["k_scale"].at[rows, :, :psz].set(kspg)
+        cc["v_scale"] = cc["v_scale"].at[rows, :, :psz].set(vspg)
+    # Pool pages are [K, psz, H] (heads major, see kv_cache.py).
+    kpages = k.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
+    vpages = v.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
+    cc["k"] = cc["k"].at[rows].set(kpages)
+    cc["v"] = cc["v"].at[rows].set(vpages)
+    return x, cc
+
+
+def _prefill_logits(
+    params: Params, x: jax.Array, lengths: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    """Next-token logits [Nb, V] off each row's last real position.
+
+    Gathers before the LM head so the vocab matmul is [Nb, 1, V], not
+    [Nb, S_pad, V]."""
+    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
+    x_last = jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, (x.shape[0], 1, x.shape[-1])), axis=1
+    )
+    return unembed(params, x_last, cfg)[:, 0]
+
+
 def prefill_step(
     params: Params,
     cache: Cache,
@@ -133,7 +293,8 @@ def prefill_step(
     matches). With P_pre == 0 the program is byte-identical to the
     pre-prefix-cache prefill. The tail's page scatter is unchanged: cached
     prefixes are page-aligned, so tail token t keeps in-page offset
-    ``t % page_size``.
+    ``t % page_size``. Chunked prefill (mixed_step) reuses this row type
+    unchanged: a chunk is a tail prefill resuming at its chunk cursor.
 
     Returns (next-token logits [Nb, V], updated cache). Rows are independent
     sequences (separate page sets); a burst of admissions is served by a
@@ -142,125 +303,145 @@ def prefill_step(
     all-zero page lists: their K/V lands on the reserved scratch page 0 and
     is never read.
     """
-    Nb, S_pad = tokens.shape
-    psz = cache["k"].shape[2]
-    NP = cache["k"].shape[0] // cfg.n_layers
-    n_pages = S_pad // psz
-    quant = "k_scale" in cache
-    P_pre = 0 if prefix_pages is None else prefix_pages.shape[1]
-    if P_pre:
-        positions = prefix_lens[:, None] + jnp.arange(S_pad, dtype=jnp.int32)
-        pre_idx = jnp.arange(P_pre * psz, dtype=jnp.int32)
-        # Prefix kv positions are absolute [0, P_pre*psz); columns past a
-        # row's own prefix are garbage -> segment id 0 (and, under SWA,
-        # behind the window anyway for pages the engine mapped to scratch).
-        kv_pos = jnp.concatenate(
-            [jnp.broadcast_to(pre_idx[None], (Nb, P_pre * psz)), positions],
-            axis=1,
-        )
-        seg = (
-            jnp.arange(S_pad, dtype=jnp.int32)[None] < lengths[:, None]
-        ).astype(jnp.int32)
-        kv_seg = jnp.concatenate(
-            [(pre_idx[None] < prefix_lens[:, None]).astype(jnp.int32), seg],
-            axis=1,
-        )
-    else:
-        positions = jnp.broadcast_to(
-            jnp.arange(S_pad, dtype=jnp.int32), (Nb, S_pad)
-        )
-        # Ragged burst: rows shorter than the bucket mark their padding tail
-        # with segment id 0 — the flash kernel SKIPS all-padding blocks, so a
-        # mixed-length admission burst pays per-row actual-length compute in
-        # one dispatch instead of bucket-padded compute per bucket.
-        seg = (positions < lengths[:, None]).astype(jnp.int32)
+    ctx = _prefill_ctx(
+        cache, tokens, lengths, pages, prefix_lens, prefix_pages, cfg
+    )
 
     def body(carry, bp, l, j):
         x, cc = carry
-        h = _norm(x, bp["attn_norm"], cfg)
-        q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
-        if P_pre:
-            # Gather this layer's cached prefix K/V pages from the pool
-            # and attend tail queries over prefix + tail. [Nb, P_pre] page
-            # rows -> [Nb, P_pre*psz, K, H] (heads-major pages).
-            Kh, Hd = k.shape[2], k.shape[3]
-            rows_pre = l * NP + prefix_pages
-            k_pre = cc["k"][rows_pre].transpose(0, 1, 3, 2, 4)
-            v_pre = cc["v"][rows_pre].transpose(0, 1, 3, 2, 4)
-            if quant:
-                ksc = cc["k_scale"][rows_pre][..., :psz]   # [Nb,P,K,psz]
-                vsc = cc["v_scale"][rows_pre][..., :psz]
-                k_pre = k_pre.astype(jnp.float32) * ksc.transpose(
-                    0, 1, 3, 2)[..., None]
-                v_pre = v_pre.astype(jnp.float32) * vsc.transpose(
-                    0, 1, 3, 2)[..., None]
-            k_pre = k_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(k.dtype)
-            v_pre = v_pre.reshape(Nb, P_pre * psz, Kh, Hd).astype(v.dtype)
-            out = attention(
-                q,
-                jnp.concatenate([k_pre, k], axis=1),
-                jnp.concatenate([v_pre, v], axis=1),
-                causal=True,
-                q_segment_ids=seg, kv_segment_ids=kv_seg, seg_pad_zero=True,
-                q_positions=positions, kv_positions=kv_pos,
-                logit_softcap=cfg.attn_logit_softcap,
-                window=cfg.layer_window(j),
-                block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
-                impl=cfg.kernels, mesh=mesh,
-            )
+        return _prefill_layer(x, cc, bp, l, j, ctx, cfg, mesh)
+
+    x = embed(params, tokens, ctx["positions"], cfg)
+    x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
+    return _prefill_logits(params, x, lengths, cfg), cache
+
+
+def _decode_ctx(
+    cache: Cache,
+    write_pos: jax.Array,
+    page_table: jax.Array,
+    cfg: ModelConfig,
+) -> dict:
+    """Batch-level tensors the per-layer decode body consumes."""
+    B = write_pos.shape[0]
+    kp = cache["k"]
+    psz = kp.shape[2]
+    NP = kp.shape[0] // cfg.n_layers
+    P = page_table.shape[1]
+    batch_idx = jnp.arange(B)
+    page_idx = page_table[batch_idx, write_pos // psz]   # [B]
+    offset = write_pos % psz                             # [B]
+    # KV positions valid after the write: arange <= write_pos; the
+    # (per-layer) sliding window narrows it inside the body.
+    kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    kv_base_mask = kv_arange <= write_pos[:, None, None]  # [B, 1, P*psz]
+
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(cfg.kernels)
+    return dict(
+        B=B, psz=psz, NP=NP, P=P, quant="k_scale" in cache,
+        write_pos=write_pos, page_table=page_table,
+        positions=write_pos[:, None], page_idx=page_idx, offset=offset,
+        kv_arange=kv_arange, kv_base_mask=kv_base_mask,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+
+
+def _decode_layer(
+    x: jax.Array,
+    cc: Cache,
+    bp: Any,
+    l,
+    j: int,
+    ctx: dict,
+    cfg: ModelConfig,
+    mesh: Optional[jax.sharding.Mesh],
+) -> tuple[jax.Array, Cache]:
+    """One transformer layer of single-token decode: fused-write ragged
+    paged attention (pallas) or scatter + masked pool gather (xla)."""
+    B, psz, NP, P = ctx["B"], ctx["psz"], ctx["NP"], ctx["P"]
+    quant = ctx["quant"]
+    write_pos, page_table = ctx["write_pos"], ctx["page_table"]
+    page_idx, offset = ctx["page_idx"], ctx["offset"]
+    cc = dict(cc)
+    win = cfg.layer_window(j)
+    h = _norm(x, bp["attn_norm"], cfg)
+    q, k, v = qkv_proj(h, bp["attn"], cfg, ctx["positions"])
+    K, H = k.shape[2], k.shape[3]
+    if ctx["use_pallas"]:
+        # Ragged paged-attention kernel: walks the page table directly
+        # (compute proportional to actual context lengths) and writes
+        # the new token's K/V itself — the pool stays in place through
+        # the kernel's input/output aliasing, where an external scatter
+        # feeding the kernel would cost a pool copy per layer. Under
+        # kv_quant the kernel also dequantizes in place and quantizes
+        # the written token (scales aliased alongside).
+        from orion_tpu.ops.pallas.paged_attention import paged_attention
+
+        res = paged_attention(
+            q[:, 0], cc["k"], cc["v"], page_table, write_pos,
+            layer_base=l * NP,
+            k_new=k[:, 0], v_new=v[:, 0],
+            logit_softcap=cfg.attn_logit_softcap,
+            window=win,
+            interpret=ctx["interpret"],
+            k_scale=cc.get("k_scale"),
+            v_scale=cc.get("v_scale"),
+            mesh=mesh,
+        )
+        if quant:
+            out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
         else:
-            out = attention(
-                q, k, v, causal=True,
-                q_segment_ids=seg, kv_segment_ids=seg, seg_pad_zero=True,
-                logit_softcap=cfg.attn_logit_softcap,
-                window=cfg.layer_window(j),
-                block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
-                impl=cfg.kernels, mesh=mesh,
-            )
-        a = out_proj(out, bp["attn"], cfg)
-        if cfg.post_norms:
-            a = _norm(a, bp["post_attn_norm"], cfg)
-        x = x + a
-        h2 = _norm(x, bp["mlp_norm"], cfg)
-        y, _ = mlp_or_moe(h2, bp, cfg)
-        if cfg.post_norms:
-            y = _norm(y, bp["post_mlp_norm"], cfg)
-        x = x + y
-        # Scatter this layer's K/V pages into the pool (in-place on the
-        # carried flat pool). Positions beyond each row's `length` hold
-        # garbage from the padding — decode masks them out via seq_lens,
-        # and the next real token overwrites its slot.
-        K, H = k.shape[2], k.shape[3]
-        rows = l * NP + pages                    # [Nb, n_pages]
-        cc = dict(cc)
+            out, cc["k"], cc["v"] = res
+        out = out[:, None]
+    else:
+        rows = l * NP + page_idx
         if quant:
             from orion_tpu.infer.kv_cache import quantize_kv
 
-            # Per (token, head) int8 + f32 scale; scale pages land in the
-            # first psz columns of the lanes-padded scale pool rows.
-            k, ks = quantize_kv(k)               # [Nb,S,K,H] i8, [Nb,S,K]
-            v, vs = quantize_kv(v)
-            kspg = ks.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
-            vspg = vs.reshape(Nb, n_pages, psz, K).transpose(0, 1, 3, 2)
-            cc["k_scale"] = cc["k_scale"].at[rows, :, :psz].set(kspg)
-            cc["v_scale"] = cc["v_scale"].at[rows, :, :psz].set(vspg)
-        # Pool pages are [K, psz, H] (heads major, see kv_cache.py).
-        kpages = k.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
-        vpages = v.reshape(Nb, n_pages, psz, K, H).transpose(0, 1, 3, 2, 4)
-        cc["k"] = cc["k"].at[rows].set(kpages)
-        cc["v"] = cc["v"].at[rows].set(vpages)
-        return x, cc
-
-    x = embed(params, tokens, positions, cfg)
-    x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
-    # Only each row's last real position is needed; gather before the LM
-    # head so the vocab matmul is [Nb, 1, V], not [Nb, S_pad, V].
-    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
-    x_last = jnp.take_along_axis(
-        x, jnp.broadcast_to(idx, (Nb, 1, x.shape[-1])), axis=1
-    )
-    logits = unembed(params, x_last, cfg)     # [Nb, 1, V]
-    return logits[:, 0], cache
+            kq, ks = quantize_kv(k[:, 0])    # [B,K,H] i8, [B,K]
+            vq, vs = quantize_kv(v[:, 0])
+            cc["k"] = cc["k"].at[rows, :, offset].set(kq)
+            cc["v"] = cc["v"].at[rows, :, offset].set(vq)
+            cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
+            cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
+        else:
+            cc["k"] = cc["k"].at[rows, :, offset].set(k[:, 0])
+            cc["v"] = cc["v"].at[rows, :, offset].set(v[:, 0])
+        # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather.
+        k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+        v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+        if quant:
+            # Dequantize the gathered context: [B, P, psz, K] scales.
+            ksc = cc["k_scale"][l * NP + page_table][..., :psz]
+            vsc = cc["v_scale"][l * NP + page_table][..., :psz]
+            k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
+                0, 1, 3, 2)[..., None]
+            v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
+                0, 1, 3, 2)[..., None]
+            k_ctx = k_ctx.astype(q.dtype)
+            v_ctx = v_ctx.astype(q.dtype)
+        k_ctx = k_ctx.reshape(B, P * psz, K, H)
+        v_ctx = v_ctx.reshape(B, P * psz, K, H)
+        kv_mask = ctx["kv_base_mask"]
+        if win is not None:
+            kv_mask = kv_mask & (
+                ctx["kv_arange"] >= (write_pos - win + 1)[:, None, None]
+            )
+        out = attention_xla(
+            q, k_ctx, v_ctx, causal=False, mask=kv_mask,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+    a = out_proj(out, bp["attn"], cfg)
+    if cfg.post_norms:
+        a = _norm(a, bp["post_attn_norm"], cfg)
+    x = x + a
+    h2 = _norm(x, bp["mlp_norm"], cfg)
+    y, _ = mlp_or_moe(h2, bp, cfg)
+    if cfg.post_norms:
+        y = _norm(y, bp["post_mlp_norm"], cfg)
+    return x + y, cc
 
 
 def _decode_core(
@@ -273,108 +454,13 @@ def _decode_core(
     mesh: Optional[jax.sharding.Mesh] = None,
 ) -> tuple[jax.Array, Cache]:
     """One decode forward for every slot -> (logits [B, V], cache')."""
-    B = tokens.shape[0]
-    kp = cache["k"]
-    psz = kp.shape[2]
-    NP = kp.shape[0] // cfg.n_layers
-    P = page_table.shape[1]
-    quant = "k_scale" in cache
-    positions = write_pos[:, None]
-    batch_idx = jnp.arange(B)
-
-    page_idx = page_table[batch_idx, write_pos // psz]   # [B]
-    offset = write_pos % psz                             # [B]
-    # KV positions valid after the write: arange <= write_pos; the
-    # (per-layer) sliding window narrows it inside the body.
-    kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
-    kv_base_mask = kv_arange <= write_pos[:, None, None]  # [B, 1, P*psz]
-
-    from orion_tpu.ops._dispatch import resolve_impl
-
-    use_pallas, interpret = resolve_impl(cfg.kernels)
+    ctx = _decode_ctx(cache, write_pos, page_table, cfg)
 
     def body(carry, bp, l, j):
         x, cc = carry
-        cc = dict(cc)
-        win = cfg.layer_window(j)
-        h = _norm(x, bp["attn_norm"], cfg)
-        q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
-        K, H = k.shape[2], k.shape[3]
-        if use_pallas:
-            # Ragged paged-attention kernel: walks the page table directly
-            # (compute proportional to actual context lengths) and writes
-            # the new token's K/V itself — the pool stays in place through
-            # the kernel's input/output aliasing, where an external scatter
-            # feeding the kernel would cost a pool copy per layer. Under
-            # kv_quant the kernel also dequantizes in place and quantizes
-            # the written token (scales aliased alongside).
-            from orion_tpu.ops.pallas.paged_attention import paged_attention
+        return _decode_layer(x, cc, bp, l, j, ctx, cfg, mesh)
 
-            res = paged_attention(
-                q[:, 0], cc["k"], cc["v"], page_table, write_pos,
-                layer_base=l * NP,
-                k_new=k[:, 0], v_new=v[:, 0],
-                logit_softcap=cfg.attn_logit_softcap,
-                window=win,
-                interpret=interpret,
-                k_scale=cc.get("k_scale"),
-                v_scale=cc.get("v_scale"),
-                mesh=mesh,
-            )
-            if quant:
-                out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
-            else:
-                out, cc["k"], cc["v"] = res
-            out = out[:, None]
-        else:
-            rows = l * NP + page_idx
-            if quant:
-                from orion_tpu.infer.kv_cache import quantize_kv
-
-                kq, ks = quantize_kv(k[:, 0])    # [B,K,H] i8, [B,K]
-                vq, vs = quantize_kv(v[:, 0])
-                cc["k"] = cc["k"].at[rows, :, offset].set(kq)
-                cc["v"] = cc["v"].at[rows, :, offset].set(vq)
-                cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
-                cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
-            else:
-                cc["k"] = cc["k"].at[rows, :, offset].set(k[:, 0])
-                cc["v"] = cc["v"].at[rows, :, offset].set(v[:, 0])
-            # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather.
-            k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
-            v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
-            if quant:
-                # Dequantize the gathered context: [B, P, psz, K] scales.
-                ksc = cc["k_scale"][l * NP + page_table][..., :psz]
-                vsc = cc["v_scale"][l * NP + page_table][..., :psz]
-                k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
-                    0, 1, 3, 2)[..., None]
-                v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
-                    0, 1, 3, 2)[..., None]
-                k_ctx = k_ctx.astype(q.dtype)
-                v_ctx = v_ctx.astype(q.dtype)
-            k_ctx = k_ctx.reshape(B, P * psz, K, H)
-            v_ctx = v_ctx.reshape(B, P * psz, K, H)
-            kv_mask = kv_base_mask
-            if win is not None:
-                kv_mask = kv_mask & (
-                    kv_arange >= (write_pos - win + 1)[:, None, None]
-                )
-            out = attention_xla(
-                q, k_ctx, v_ctx, causal=False, mask=kv_mask,
-                logit_softcap=cfg.attn_logit_softcap,
-            )
-        a = out_proj(out, bp["attn"], cfg)
-        if cfg.post_norms:
-            a = _norm(a, bp["post_attn_norm"], cfg)
-        x = x + a
-        h2 = _norm(x, bp["mlp_norm"], cfg)
-        y, _ = mlp_or_moe(h2, bp, cfg)
-        if cfg.post_norms:
-            y = _norm(y, bp["post_mlp_norm"], cfg)
-        return x + y, cc
-
-    x = embed(params, tokens[:, None], positions, cfg)
+    x = embed(params, tokens[:, None], ctx["positions"], cfg)
     x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
     logits = unembed(params, x, cfg)          # [B, 1, V]
     return logits[:, 0], cache
@@ -423,3 +509,81 @@ def decode_window(
         stepf, (tokens, seq_lens, dict(cache)), keys
     )
     return toks, cache
+
+
+def mixed_step(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B] newest token per decode slot
+    seq_lens: jax.Array,      # [B] int32
+    page_table: jax.Array,    # [B, pages_per_seq] int32; mid-prefill slots
+    #                           carry all-zero rows (their write -> scratch)
+    active: jax.Array,        # [B] bool: slot holds a DECODING request
+    key: jax.Array,           # PRNG key for the decode sample
+    p_tokens: jax.Array,      # [Nc, S_chunk] prompt-chunk tail tokens
+    p_lengths: jax.Array,     # [Nc] int32: true chunk lengths
+    p_pages: jax.Array,       # [Nc, S_chunk // psz] pages the chunk writes
+    p_prefix_lens: jax.Array, # [Nc] int32: context tokens already in cache
+    p_prefix_pages: jax.Array,  # [Nc, P_pre] pages holding that context
+    temperature: jax.Array,   # [B] f32 per-request decode sampling params
+    top_k: jax.Array,         # [B] i32   (python scalars for the all-
+    top_p: jax.Array,         # [B] f32    defaults greedy specialization)
+    *,
+    cfg: ModelConfig,
+    max_seq_len: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> tuple[jax.Array, jax.Array, Cache]:
+    """One UNIFIED mixed prefill+decode step (inference.chunked_prefill):
+    a single-token decode for every live slot fused with up to the chunk
+    budget of prompt-tail tokens, in ONE dispatch.
+
+    Returns ``(decode_tokens [B], chunk_logits [Nc, V], cache)``.
+
+    Each layer runs the decode body (fused-write ragged paged attention —
+    the same math as ``decode_window`` with W=1, so the greedy decode
+    stream is bit-identical to unchunked serving; sampled decode matches
+    a decode_window=1 engine at equal PRNG state, while W>1 windows group
+    key splits differently) and the prefill body (a
+    prefill chunk is exactly the prefix-cache mid-sequence tail prefill:
+    resume at a page-aligned ``p_prefix_lens`` over the pages earlier
+    chunks already wrote, flash attention with per-row segment ids
+    skipping padding blocks) over the SAME carried pool and the SAME
+    block params — one pass over the weights serves both, which is the
+    MBU point of mixing: bandwidth-bound decode and compute-bound prefill
+    share the chip instead of alternating. Chunk rows and decode rows
+    touch disjoint pages (a slot is either decoding or prefilling, and
+    mid-prefill slots' decode rows are masked onto scratch page 0 by the
+    engine), so the two in-place pool updates commute.
+
+    ``chunk_logits`` holds every chunk row's last-position logits; the
+    host samples only the rows whose prompt just completed (fetching the
+    array lazily, so non-finishing steps never pay the [Nc, V] transfer).
+    """
+    from orion_tpu.infer.sampling import sample
+
+    del active  # host-side bookkeeping filters; kept for decode parity
+    wp = jnp.minimum(seq_lens, max_seq_len - 1)
+    pctx = _prefill_ctx(
+        cache, p_tokens, p_lengths, p_pages, p_prefix_lens, p_prefix_pages,
+        cfg,
+    )
+    dctx = _decode_ctx(cache, wp, page_table, cfg)
+
+    def body(carry, bp, l, j):
+        xp, xd, cc = carry
+        xp, cc = _prefill_layer(xp, cc, bp, l, j, pctx, cfg, mesh)
+        xd, cc = _decode_layer(xd, cc, bp, l, j, dctx, cfg, mesh)
+        return xp, xd, cc
+
+    xp = embed(params, p_tokens, pctx["positions"], cfg)
+    xd = embed(params, tokens[:, None], dctx["positions"], cfg)
+    xp, xd, cache = _scan_layers(params, cfg, body, (xp, xd, dict(cache)))
+    # Two unembed calls, not one over a concat: the decode half must stay
+    # op-for-op identical to decode_window's so its tokens are bitwise
+    # unchanged by the rider chunk rows.
+    d_logits = unembed(params, xd, cfg)[:, 0]            # [B, V]
+    toks = sample(
+        d_logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    p_logits = _prefill_logits(params, xp, p_lengths, cfg)
+    return toks, p_logits, cache

@@ -106,6 +106,41 @@ class PrefixCacheStats:
         }
 
 
+@dataclass
+class LatencyStats:
+    """Streaming latency collector for the serving benches (SURVEY.md §6
+    metrics): record per-event wall times (TTFT, inter-token gaps), report
+    percentiles. The serving SLO quantities — p50/p99 ITL under prompt
+    bursts, max decode stall — are wall-clock host-side measurements, so
+    they live with the bench driver (tools/serving_latency_bench.py), not
+    inside the engine; the engine exposes the counters (reset_timing) this
+    class turns into a distribution summary."""
+
+    samples: list[float] = field(default_factory=list)
+
+    def record(self, seconds: float) -> None:
+        self.samples.append(float(seconds))
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile (p in [0, 100]); 0.0 when empty."""
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        rank = max(int(-(-p / 100.0 * len(s) // 1)) - 1, 0)  # ceil - 1
+        return s[min(rank, len(s) - 1)]
+
+    def summary(self) -> dict[str, float]:
+        n = len(self.samples)
+        return {
+            "count": n,
+            "mean": sum(self.samples) / n if n else 0.0,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+            "max": max(self.samples) if n else 0.0,
+        }
+
+
 class MetricsLogger:
     """Accumulates per-step metrics; writes console lines and optional JSONL."""
 

@@ -35,6 +35,13 @@ KV write itself:
     [K*G8, H] q block.
 
 Decode is inference-only; no VJP is defined.
+
+Under chunked prefill (``runner.mixed_step``) this kernel serves the
+decode rows of the unified mixed dispatch — same contract, one query
+token per sequence with the fused in-place write — while prompt-chunk
+rows ride the flash kernel's segment-id path in the same program; the
+two in-place pool updates touch disjoint pages (the engine masks
+mid-prefill slots' decode rows onto the scratch page).
 """
 
 from __future__ import annotations

@@ -365,6 +365,41 @@ def test_decode_window_autotune_grows_and_preserves_tokens():
     assert t["prefill_s"] > 0.0             # admission burst has its own bucket
 
 
+def test_decode_window_autotune_shrinks_on_low_host_share():
+    """The autotune is no longer growth-only: when the per-step host share
+    falls below a quarter of the target, the window halves (hysteresis
+    band [target/4, target] is stable), flooring at the configured
+    inference.decode_window — so a load drop is not stuck with a doubled
+    window's ITL forever. Driven directly through the measured-split hook
+    so the decision rule is pinned, not the CPU timing."""
+    acfg, params = _setup(overrides=[
+        "inference.decode_window=2",
+        "inference.decode_window_autotune=true",
+        "inference.decode_window_max=16",
+    ])
+    eng = InferenceEngine(acfg, params)
+    eng.decode_window = 16
+    # Host share 0.01 < target 0.25 / 4: halve.
+    eng._dev_span, eng._prefill_span = 0.99, 0.0
+    eng._autotune_window(1.0)
+    assert eng.decode_window == 8
+    # In the hysteresis band [target/4, target]: hold.
+    eng._dev_span = 0.9
+    eng._autotune_window(1.0)
+    assert eng.decode_window == 8
+    # Above target: grow (the original path, bounded by the max).
+    eng._dev_span = 0.5
+    eng._autotune_window(1.0)
+    assert eng.decode_window == 16
+    # Shrink floors at the CONFIGURED window, never below.
+    eng.decode_window = 2
+    eng._dev_span = 0.99
+    eng._autotune_window(1.0)
+    assert eng.decode_window == 2
+    # The current window is surfaced with the timing drain.
+    assert eng.reset_timing()["decode_window"] == 2
+
+
 def test_wasted_decode_fraction_pinned_mixed_lengths():
     """The device/host split now carries the decode-waste tally: at a mixed
     max_new_tokens trace with W=8, the slot finishing after 1 decoded token
