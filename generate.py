@@ -47,6 +47,14 @@ def main(argv: list[str] | None = None) -> int:
                              "chunks mixed into each decode step; sugar "
                              "for inference.chunked_prefill=true (budget "
                              "via inference.prefill_chunk_tokens=N)")
+    parser.add_argument("--speculate", type=int, default=None, metavar="N",
+                        help="speculative decoding: draft up to N tokens "
+                             "per step by prompt-lookup (n-gram) and "
+                             "verify them in one dispatch; greedy output "
+                             "is byte-identical, sampled output keeps its "
+                             "distribution; sugar for "
+                             "inference.speculative=true + "
+                             "inference.speculate_tokens=N")
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides"
     )
@@ -76,6 +84,11 @@ def main(argv: list[str] | None = None) -> int:
             overrides.append(f"{key}={flag}")
     if args.chunked_prefill:
         overrides.append("inference.chunked_prefill=true")
+    if args.speculate is not None:
+        if args.speculate < 1:
+            raise SystemExit(f"--speculate must be >= 1, got {args.speculate}")
+        overrides.append("inference.speculative=true")
+        overrides.append(f"inference.speculate_tokens={args.speculate}")
     cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 

@@ -44,9 +44,12 @@ class CheckpointManager:
     # old-format checkpoints are gone); restore warns on mismatch instead
     # of silently training on a different shuffle. Sidecar rather than an
     # Orbax item: old checkpoints stay restorable unchanged. Stamping
-    # happens only at commit — inline for sync saves, at the wait()
-    # barrier for async ones — so a crash mid-async-save cannot stamp a
-    # directory whose only committed checkpoints are old-format.
+    # happens only at commit — inline for sync saves; for async ones at
+    # the start of the NEXT committing save() (once the prior async save
+    # has landed) or at the wait()/close() barrier, whichever comes
+    # first, bounding the stamp lag to one save interval — so a crash
+    # mid-async-save cannot stamp a directory whose only committed
+    # checkpoints are old-format.
     @property
     def _fmt_path(self) -> str:
         return os.path.join(self._dir, "stream_format.json")
@@ -94,6 +97,24 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Save if the step matches the save interval (or force)."""
+        if getattr(self, "_stamp_pending", False) and (
+            force or self._mgr.should_save(step)
+        ):
+            # Flush the stamp owed by the PREVIOUS async save now that it
+            # has committed — gated on THIS call actually saving, because
+            # the trainer invokes save() every step and an unconditional
+            # wait here would stall the training loop right after each
+            # async save (the stall async checkpointing exists to hide).
+            # When a new save does fire, Orbax serializes it behind the
+            # prior async commit anyway, so this wait adds no extra
+            # stall. Without the flush, a run that crashes before
+            # wait()/close() would leave every committed checkpoint of
+            # the run unstamped and resume would warn "written before
+            # round 5" spuriously; with it, stamp lag is ONE save
+            # interval.
+            self._mgr.wait_until_finished()
+            self._stamp_pending = False
+            self._stamp_stream_format()
         if step in self._mgr.all_steps():
             return False
         saved = self._mgr.save(
@@ -101,7 +122,8 @@ class CheckpointManager:
         )
         if saved:
             if self.cfg.async_save:
-                self._stamp_pending = True   # stamped at the wait() barrier
+                self._stamp_pending = True   # flushed at the next save()
+                #                              or the wait()/close() barrier
             else:
                 self._stamp_stream_format()
             log.info("checkpoint saved at step %d", step)

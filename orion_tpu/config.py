@@ -451,6 +451,34 @@ class InferenceConfig:
     # resumed chunk starts page-aligned, reusing the prefix-cache
     # mid-sequence prefill path unchanged).
     prefill_chunk_tokens: int = 256
+    # Speculative decoding (draft-model-free): a host-side prompt-lookup /
+    # n-gram proposer (infer/spec_decode.py) drafts up to speculate_tokens
+    # continuation tokens per request from the request's OWN prompt+output
+    # (and, with prefix_cache, from the radix tree's cached token paths);
+    # one verify dispatch (runner.verify_step) scores every live slot's
+    # drafts in a single pass over the weights and the engine accepts the
+    # matched prefix plus one bonus/correction token. Greedy acceptance is
+    # exact argmax match (spec-on output byte-identical to spec-off);
+    # sampled acceptance uses rejection sampling, so the output
+    # DISTRIBUTION is provably unchanged (the sampled stream itself draws
+    # from a different key sequence). The win is self-repetitive text
+    # (code, structured output, looping continuations): up to
+    # speculate_tokens+1 emitted tokens per weight pass instead of 1. Off
+    # by default; see PERF.md "Speculative decoding" and
+    # tools/spec_decode_bench.py.
+    speculative: bool = False
+    # Max draft tokens verified per request per step (the verify dispatch
+    # is always speculate_tokens+1 wide — rows with shorter/no drafts pad
+    # via per-slot real lengths, so there is ONE jit specialization). The
+    # per-request draft length adapts inside [1, speculate_tokens]:
+    # halving on low acceptance, doubling back on full acceptance.
+    speculate_tokens: int = 4
+    # N-gram window for the prompt-lookup proposer: the last n tokens of
+    # the context are matched (n from spec_ngram_max down to
+    # spec_ngram_min) against earlier context; the continuation of the
+    # most recent match is the draft.
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
 
 @dataclass(frozen=True)

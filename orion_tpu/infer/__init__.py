@@ -8,22 +8,38 @@ streams requests in and tokens out. With ``inference.chunked_prefill`` the
 two programs fuse into a third: ``runner.mixed_step`` runs one decode
 token per live slot plus a bounded prompt chunk per dispatch, so a prompt
 burst can never stall in-flight decodes by more than the chunk budget.
+With ``inference.speculative`` a host-side prompt-lookup proposer
+(``spec_decode``) drafts continuation tokens and ``runner.verify_step``
+scores every slot's drafts in one pass over the weights — up to
+speculate_tokens+1 emitted tokens per dispatch on self-repetitive text,
+greedy output byte-identical, sampled output distribution-preserving.
 """
 
 from orion_tpu.infer.engine import InferenceEngine, Request
 from orion_tpu.infer.kv_cache import PageAllocator, init_cache
 from orion_tpu.infer.prefix_cache import PrefixCache
-from orion_tpu.infer.runner import decode_window, mixed_step, prefill_step
+from orion_tpu.infer.runner import (
+    decode_window,
+    mixed_step,
+    mixed_verify_step,
+    prefill_step,
+    verify_step,
+)
 from orion_tpu.infer.sampling import sample
+from orion_tpu.infer.spec_decode import NgramProposer, propose_ngram
 
 __all__ = [
     "InferenceEngine",
     "Request",
+    "NgramProposer",
     "PageAllocator",
     "PrefixCache",
     "decode_window",
     "init_cache",
     "mixed_step",
+    "mixed_verify_step",
     "prefill_step",
+    "propose_ngram",
     "sample",
+    "verify_step",
 ]

@@ -28,10 +28,17 @@ from orion_tpu.infer.kv_cache import (
     copy_page,
     init_cache,
     pages_per_seq,
+    rollback_pages,
 )
-from orion_tpu.infer.runner import decode_window, mixed_step, prefill_step
+from orion_tpu.infer.runner import (
+    decode_window,
+    mixed_step,
+    mixed_verify_step,
+    prefill_step,
+    verify_step,
+)
 from orion_tpu.infer.sampling import sample
-from orion_tpu.metrics import PrefixCacheStats
+from orion_tpu.metrics import PrefixCacheStats, SpecDecodeStats
 
 log = logging.getLogger("orion_tpu.infer")
 
@@ -299,6 +306,58 @@ class InferenceEngine:
         # not per dispatch).
         self._null_key = jax.random.key(0)
 
+        # Speculative decoding (inference.speculative): host-side n-gram
+        # proposer (infer/spec_decode.py) + single-dispatch batched
+        # verification (runner.verify_step / mixed_verify_step). The
+        # verify width is STATIC at speculate_tokens+1 — per-request
+        # adaptive draft lengths ride the `lens` argument, so there is
+        # one jit specialization, not one per draft-length mix.
+        self._spec = None
+        self.spec_stats = SpecDecodeStats()
+        self._spec_step = False     # this step ran verify, not decode
+        self._autotune_skip = False  # first step after a window resize
+        if self.icfg.speculative:
+            from orion_tpu.infer.spec_decode import NgramProposer
+
+            self._spec = NgramProposer(
+                speculate_tokens=self.icfg.speculate_tokens,
+                max_n=self.icfg.spec_ngram_max,
+                min_n=self.icfg.spec_ngram_min,
+            )
+            self._verify = jax.jit(
+                partial(
+                    verify_step, cfg=self.mcfg,
+                    max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
+                ),
+                donate_argnums=(1,),
+            )
+            self._verify_defaults = jax.jit(
+                partial(
+                    verify_step, cfg=self.mcfg,
+                    max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
+                    temperature=self.icfg.temperature,
+                    top_k=self.icfg.top_k, top_p=self.icfg.top_p,
+                ),
+                donate_argnums=(1,),
+            )
+            if self.chunked:
+                self._mixed_verify = jax.jit(
+                    partial(
+                        mixed_verify_step, cfg=self.mcfg,
+                        max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
+                    ),
+                    donate_argnums=(1,),
+                )
+                self._mixed_verify_defaults = jax.jit(
+                    partial(
+                        mixed_verify_step, cfg=self.mcfg,
+                        max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
+                        temperature=self.icfg.temperature,
+                        top_k=self.icfg.top_k, top_p=self.icfg.top_p,
+                    ),
+                    donate_argnums=(1,),
+                )
+
     # -- public API --------------------------------------------------------
 
     def submit(
@@ -394,6 +453,7 @@ class InferenceEngine:
         t0 = time.perf_counter()
         self._dev_span = 0.0
         self._prefill_span = 0.0
+        self._spec_step = False
         self._admit()
         mixed = self.chunked and any(
             r is not None and r.prefill_pending and not r.done
@@ -410,8 +470,21 @@ class InferenceEngine:
             # While chunked prefill is in flight the decode window is
             # clamped to 1 (the mixed step); autotune only reads clean
             # decode-window timings, so mixed steps never resize it.
-            if self.icfg.decode_window_autotune and not mixed:
-                self._autotune_window(total)
+            # Speculative verify steps are held out the same way: their
+            # dispatch is the static verify shape, not the [W, B] decode
+            # window, so their split says nothing about the window.
+            if (
+                self.icfg.decode_window_autotune
+                and not mixed and not self._spec_step
+            ):
+                if self._autotune_skip:
+                    # First decode-window step at a freshly-resized [W, B]
+                    # shape: its spans carry the retrace/recompile cost,
+                    # not steady-state timing — excluded from the tuner
+                    # (see _autotune_window).
+                    self._autotune_skip = False
+                else:
+                    self._autotune_window(total)
         if self.mcfg.debug_asserts:
             from orion_tpu.runtime.asserts import raise_if_failed
 
@@ -447,15 +520,20 @@ class InferenceEngine:
         remainder), windows/steps counters, the slot_steps/wasted_steps
         decode-waste tally, the mixed_steps/prefill_chunks/chunk_tokens/
         chunk_pad_tokens chunked-prefill tally, the CURRENT decode_window
-        (after any autotune growth/shrink — a snapshot, not zeroed), and —
-        with inference.prefix_cache — the prefix-cache counters
+        (after any autotune growth/shrink — a snapshot, not zeroed), with
+        inference.prefix_cache the prefix-cache counters
         (prefix_hits/misses/hit_rate, cached_tokens, inserted/evicted/cow
-        pages)."""
+        pages), and with inference.speculative the speculation counters
+        (spec_drafted/accepted/rolled_back/emitted, spec_acceptance_rate,
+        verify_steps, verify_slot_steps, spec_tokens_per_verify)."""
         out, self.timing = self.timing, self._zero_timing()
         out["decode_window"] = self.decode_window
         if self._pcache is not None:
             out.update(self.prefix_stats.as_timing())
             self.prefix_stats = PrefixCacheStats()
+        if self._spec is not None:
+            out.update(self.spec_stats.as_timing())
+            self.spec_stats = SpecDecodeStats()
         return out
 
     def _autotune_window(self, step_total: float) -> None:
@@ -467,7 +545,16 @@ class InferenceEngine:
         ITL forever. Floors at the configured inference.decode_window,
         caps at decode_window_max. Uses the step's own measured split, so
         one outlier pass (e.g. a compile) moves the window at most one
-        notch."""
+        notch.
+
+        Every resize changes the [W, B] decode shape and forces a full
+        retrace/recompile of the fused decode program on the NEXT decode
+        dispatch; that compile lands inside that step's device span and
+        would distort the very split this tuner reads, so step() excludes
+        the first post-resize decode-window step from tuning
+        (_autotune_skip) — the recompile cost is paid once per resize
+        either way, but it can no longer cascade into a second, spurious
+        resize."""
         host = step_total - self._dev_span - self._prefill_span
         denom = step_total if step_total > 0 else 1.0
         target = self.icfg.decode_host_share_target
@@ -476,6 +563,7 @@ class InferenceEngine:
             and self.decode_window * 2 <= self.icfg.decode_window_max
         ):
             self.decode_window *= 2
+            self._autotune_skip = True
             log.info(
                 "decode_window autotune: host share %.2f > %.2f, window -> %d",
                 host / denom, target, self.decode_window,
@@ -485,6 +573,7 @@ class InferenceEngine:
             and self.decode_window // 2 >= self.icfg.decode_window
         ):
             self.decode_window //= 2
+            self._autotune_skip = True
             log.info(
                 "decode_window autotune: host share %.2f < %.2f, window -> %d",
                 host / denom, target / 4, self.decode_window,
@@ -694,10 +783,18 @@ class InferenceEngine:
     def _provision_window(self) -> int:
         """The decode window the pool must budget for: with auto-tune on,
         the cap the window may grow to — admission/submit checks against
-        this, so growth never strands an admitted request."""
-        if self.icfg.decode_window_autotune:
-            return self.icfg.decode_window_max
-        return self.decode_window
+        this, so growth never strands an admitted request. With
+        speculation on, also at least speculate_tokens+1: a verify step
+        writes draft KV that far past the cursor, and its page
+        provisioning must never preempt a request admission promised to
+        hold."""
+        base = (
+            self.icfg.decode_window_max
+            if self.icfg.decode_window_autotune else self.decode_window
+        )
+        if self.icfg.speculative:
+            base = max(base, self.icfg.speculate_tokens + 1)
+        return base
 
     def _first_live_page(self, context_len: int) -> int:
         """First logical page a sequence at ``context_len`` can still read.
@@ -988,6 +1085,10 @@ class InferenceEngine:
         self.alloc.free([p for p in req.pages if p is not None])
         req.pages = []
         req.n_prefix = 0
+        if self._spec is not None:
+            # Adaptive draft-length state dies with the slot; a preempted
+            # request restarts adaptation cold on re-admission.
+            self._spec.drop(req.rid)
 
     def _preempt(self, req: Request) -> None:
         """Evict an active request, returning its pages; it re-enters at the
@@ -1010,13 +1111,16 @@ class InferenceEngine:
         self.last_token[slot] = 0
         self.waiting.appendleft(req)
 
-    def _grow_pages(self) -> None:
+    def _grow_pages(self, window: Optional[int] = None) -> None:
         """Pre-provision every active slot with pages covering the whole
         upcoming decode window (the device writes up to W positions ahead of
         the host's view, including past mid-window EOS), preempting the
         youngest-admitted request under pool pressure (oldest requests keep
-        making progress; no mid-decode crash)."""
-        W = self.decode_window
+        making progress; no mid-decode crash). ``window`` overrides the
+        span for verify steps (speculate_tokens+1 write positions per
+        slot — always within _provision_window, which admission budgeted
+        for)."""
+        W = self.decode_window if window is None else window
         by_age = sorted(
             (r for r in self.slots if r is not None and not r.done),
             key=lambda r: r.admit_seq,
@@ -1050,8 +1154,183 @@ class InferenceEngine:
                 self.page_table[req.slot, len(req.pages)] = page
                 req.pages.append(page)
 
+    def _propose_drafts(
+        self, cands: list[Request]
+    ) -> Optional[dict[int, list[int]]]:
+        """Host-side drafting pass (inference.speculative): an n-gram
+        draft per candidate slot, keyed by slot. None when NOTHING was
+        drafted — the caller falls back to the plain decode window, so a
+        non-repetitive workload pays only the proposal scan. The draft
+        length is capped per request by the adaptive state, the context
+        window (write positions must stay below max_seq_len) and the
+        request's remaining token budget (drafting past max_new_tokens
+        is guaranteed rollback)."""
+        if not cands:
+            return None
+        extra = (
+            self._pcache.token_paths() if self._pcache is not None else ()
+        )
+        drafts: dict[int, list[int]] = {}
+        any_draft = False
+        for r in cands:
+            pos = int(self.seq_lens[r.slot])
+            limit = min(
+                self.icfg.max_seq_len - 1 - pos,
+                r.max_new_tokens - len(r.generated) - 1,
+            )
+            d = (
+                self._spec.propose(r.rid, r.context, limit, extra)
+                if limit > 0 else []
+            )
+            drafts[r.slot] = d
+            any_draft = any_draft or bool(d)
+        return drafts if any_draft else None
+
+    def _build_verify_rows(
+        self, reqs: list[Request], drafts: dict[int, list[int]]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The [B, speculate_tokens+1] verify-row layout BOTH dispatch
+        paths (_verify_all, _mixed_decode) feed the device and
+        _accept_and_rollback later walks: column 0 the pending last
+        token, columns 1..1+len(d) the drafts, ``lens`` the per-slot real
+        width. Rows without a request stay (zeros, len 1) — masked onto
+        scratch by the device side."""
+        W = self.icfg.speculate_tokens + 1
+        tokens = np.zeros((self.max_batch, W), np.int32)
+        lens = np.ones(self.max_batch, np.int32)
+        for r in reqs:
+            d = drafts.get(r.slot, [])
+            tokens[r.slot, 0] = self.last_token[r.slot]
+            if d:
+                tokens[r.slot, 1:1 + len(d)] = d
+            lens[r.slot] = 1 + len(d)
+        return tokens, lens
+
+    def _verify_all(self, drafts: dict[int, list[int]]) -> bool:
+        """One verify dispatch for every live decode slot: K drafts + the
+        pending last token per slot, scored in a single pass over the
+        weights (runner.verify_step); accept the matched prefix + one
+        bonus/correction token, then rewind the rejected tail."""
+        self._grow_pages(self.icfg.speculate_tokens + 1)
+        # Recompute AFTER provisioning: pool pressure may have preempted
+        # a drafted slot (its drafts entry simply goes unread).
+        active = [r for r in self.slots if r is not None and not r.done]
+        if not active:
+            self._reap()
+            return False
+        if not any(drafts.get(r.slot) for r in active):
+            # Every drafted slot was preempted by the provisioning pass:
+            # a verify dispatch would be all padding. Run the plain
+            # window instead (it re-provisions to the decode window).
+            self._spec_step = False
+            return self._decode_window_all()
+        tokens, lens = self._build_verify_rows(active, drafts)
+        mask = np.zeros(self.max_batch, bool)
+        for r in active:
+            mask[r.slot] = True
+        self._key, sub = jax.random.split(self._key)
+        common = (
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(self.seq_lens),
+            jnp.asarray(lens),
+            jnp.asarray(self.page_table),
+            jnp.asarray(mask),
+            sub,
+        )
+        t_dev = time.perf_counter()
+        if all(
+            r.temperature is None and r.top_k is None and r.top_p is None
+            for r in active
+        ):
+            acc, alt, self.cache = self._verify_defaults(*common)
+        else:
+            acc, alt, self.cache = self._verify(
+                *common,
+                jnp.asarray(self.slot_temp),
+                jnp.asarray(self.slot_top_k),
+                jnp.asarray(self.slot_top_p),
+            )
+        acc, alt = jax.device_get((acc, alt))   # ONE fetch
+        self._dev_span += time.perf_counter() - t_dev
+        self.timing["slot_steps"] += len(active)
+        self._accept_and_rollback(active, tokens, lens, acc, alt)
+        self._reap()
+        return True
+
+    def _accept_and_rollback(
+        self,
+        active: list[Request],
+        tokens: np.ndarray,
+        lens: np.ndarray,
+        acc: np.ndarray,
+        alt: np.ndarray,
+    ) -> None:
+        """Walk each slot's verify verdicts: emit the accepted draft
+        prefix plus alt at the first rejection (the correction) or at the
+        row's end (the bonus), then rewind — cursor stays at the last
+        emitted token (it only ever advanced by emissions) and pages
+        covering only rejected positions go back to the pool
+        (kv_cache.rollback_pages), leaving exactly the page footprint a
+        non-speculative window=1 step would have left. Rejected KV beyond
+        the cursor is dead by the seq_lens masking invariant, the same
+        way decode-window overshoot is."""
+        st = self.spec_stats
+        st.verify_steps += 1
+        st.verify_slot_steps += len(active)
+        for r in active:
+            s = r.slot
+            k = int(lens[s]) - 1
+            a = 0
+            while a < k and acc[s, a]:
+                a += 1
+            emit = [int(t) for t in tokens[s, 1:1 + a]] + [int(alt[s, a])]
+            n_emit = 0
+            for tok in emit:
+                if r.done:
+                    break
+                self.seq_lens[s] += 1
+                self.last_token[s] = tok
+                r.generated.append(tok)
+                n_emit += 1
+                self._maybe_finish(r, tok)
+            kept = min(n_emit, a)       # draft tokens that reached the stream
+            st.drafted += k
+            st.accepted += kept
+            st.rolled_back += k - kept
+            st.emitted += n_emit
+            self._spec.state(r.rid).update(
+                k, kept, self.icfg.speculate_tokens
+            )
+            if not r.done:
+                # Finished slots skip this: _reap releases everything and
+                # donates only full pages below the (rewound) cursor.
+                self._rollback_slot(r)
+
+    def _rollback_slot(self, req: Request) -> None:
+        """Release the pages a verify step provisioned beyond the
+        accepted cursor (speculative rollback, kv_cache.rollback_pages)."""
+        n_keep = (int(self.seq_lens[req.slot]) - 1) // self.psz + 1
+        if len(req.pages) > n_keep:
+            rollback_pages(self.alloc, req.pages, n_keep)
+            self.page_table[req.slot, n_keep:] = 0
+
     def _decode_all(self) -> bool:
         self._roll_window()
+        if self._spec is not None:
+            drafts = self._propose_drafts(
+                [r for r in self.slots if r is not None and not r.done]
+            )
+            if drafts is not None:
+                self._spec_step = True
+                return self._verify_all(drafts)
+        return self._decode_window_all()
+
+    def _decode_window_all(self) -> bool:
+        """The plain fused decode window over all live slots (the
+        non-speculative step body; also the verify path's fallback when
+        preemption strips every drafted slot)."""
         self._grow_pages()
         active = [r for r in self.slots if r is not None and not r.done]
         if not active:
@@ -1108,9 +1387,23 @@ class InferenceEngine:
         up to prefill_chunk_tokens of prompt tail, in ONE dispatch — the
         stall any in-flight decode observes under a prompt burst is
         bounded by the chunk budget, never the whole quadratic prompt.
-        Returns True iff any decode slot advanced."""
+        Returns True iff any decode slot advanced.
+
+        Speculation composes here (runner.mixed_verify_step): decode-phase
+        slots draft and verify up to speculate_tokens per mixed step while
+        prompt-phase slots skip drafting — their prompts ARE the chunk
+        rows — so a prompt burst and a speculation streak share one
+        dispatch."""
         self._roll_window()
-        self._grow_pages()
+        drafts = None
+        if self._spec is not None:
+            drafts = self._propose_drafts([
+                r for r in self.slots
+                if r is not None and not r.done and not r.prefill_pending
+            ])
+        self._grow_pages(
+            self.icfg.speculate_tokens + 1 if drafts is not None else None
+        )
         psz = self.psz
         S = self.icfg.prefill_chunk_tokens
         # Chunk assembly: pending prompts in admission order (head-of-line
@@ -1175,6 +1468,11 @@ class InferenceEngine:
             r for r in self.slots
             if r is not None and not r.done and not r.prefill_pending
         ]
+        if drafts is not None and not any(drafts.get(r.slot) for r in dec):
+            # The drafted slot(s) were preempted by this step's page
+            # provisioning: nothing left to verify — take the plain
+            # 1-token mixed step instead of a padding-only verify.
+            drafts = None
         mask = np.array(
             [
                 r is not None and not r.done and not r.prefill_pending
@@ -1193,35 +1491,68 @@ class InferenceEngine:
             # sampled chunked-vs-unchunked equivalence needs one split
             # per SAMPLING event, not per dispatch.
             sub = self._null_key
-        common = (
-            self.params,
-            self.cache,
-            jnp.asarray(self.last_token),
-            jnp.asarray(self.seq_lens),
-            jnp.asarray(d_pt),
-            jnp.asarray(mask),
-            sub,
+        chunk_args = (
             jnp.asarray(tokens),
             jnp.asarray(lengths),
             jnp.asarray(pages),
             jnp.asarray(pre_lens),
             jnp.asarray(pre_pages),
         )
-        t_dev = time.perf_counter()
-        if all(
+        defaults = all(
             r.temperature is None and r.top_k is None and r.top_p is None
             for r in dec
-        ):
-            d_toks, p_logits, self.cache = self._mixed_defaults(*common)
+        )
+        override_args = (
+            jnp.asarray(self.slot_temp),
+            jnp.asarray(self.slot_top_k),
+            jnp.asarray(self.slot_top_p),
+        )
+        if drafts is not None:
+            # Speculative mixed step: verify rows replace the 1-token
+            # decode rows (runner.mixed_verify_step); prompt-phase slots
+            # are plain chunk rows, exactly as without speculation.
+            self._spec_step = True
+            vtok, vlens = self._build_verify_rows(dec, drafts)
+            common = (
+                self.params,
+                self.cache,
+                jnp.asarray(vtok),
+                jnp.asarray(self.seq_lens),
+                jnp.asarray(vlens),
+                jnp.asarray(d_pt),
+                jnp.asarray(mask),
+                sub,
+            ) + chunk_args
+            t_dev = time.perf_counter()
+            if defaults:
+                acc, alt, p_logits, self.cache = (
+                    self._mixed_verify_defaults(*common)
+                )
+            else:
+                acc, alt, p_logits, self.cache = self._mixed_verify(
+                    *common, *override_args
+                )
+            acc, alt = jax.device_get((acc, alt))   # ONE fetch
+            self._dev_span += time.perf_counter() - t_dev
         else:
-            d_toks, p_logits, self.cache = self._mixed(
-                *common,
-                jnp.asarray(self.slot_temp),
-                jnp.asarray(self.slot_top_k),
-                jnp.asarray(self.slot_top_p),
-            )
-        d_out = np.asarray(jax.device_get(d_toks))   # [B], ONE fetch
-        self._dev_span += time.perf_counter() - t_dev
+            common = (
+                self.params,
+                self.cache,
+                jnp.asarray(self.last_token),
+                jnp.asarray(self.seq_lens),
+                jnp.asarray(d_pt),
+                jnp.asarray(mask),
+                sub,
+            ) + chunk_args
+            t_dev = time.perf_counter()
+            if defaults:
+                d_toks, p_logits, self.cache = self._mixed_defaults(*common)
+            else:
+                d_toks, p_logits, self.cache = self._mixed(
+                    *common, *override_args
+                )
+            d_out = np.asarray(jax.device_get(d_toks))   # [B], ONE fetch
+            self._dev_span += time.perf_counter() - t_dev
         real = sum(k for _, k in chunks)
         self.timing["mixed_steps"] += 1
         self.timing["prefill_chunks"] += len(chunks)
@@ -1252,14 +1583,19 @@ class InferenceEngine:
                 r.generated.append(tok)
                 self._maybe_finish(r, tok)
 
-        # Decode bookkeeping: W = 1, so no mid-window waste by construction.
+        # Decode bookkeeping. Speculative: accepted prefix + bonus per
+        # slot, then rollback (same walk as the pure verify step).
+        # Otherwise W = 1, so no mid-window waste by construction.
         self.timing["slot_steps"] += len(dec)
-        for r in dec:
-            tok = int(d_out[r.slot])
-            self.seq_lens[r.slot] += 1
-            self.last_token[r.slot] = tok
-            r.generated.append(tok)
-            self._maybe_finish(r, tok)
+        if drafts is not None:
+            self._accept_and_rollback(dec, vtok, vlens, acc, alt)
+        else:
+            for r in dec:
+                tok = int(d_out[r.slot])
+                self.seq_lens[r.slot] += 1
+                self.last_token[r.slot] = tok
+                r.generated.append(tok)
+                self._maybe_finish(r, tok)
         self._reap()
         return bool(dec)
 

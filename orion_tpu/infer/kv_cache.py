@@ -168,6 +168,36 @@ class PageAllocator:
             self.release(p)
 
 
+def rollback_pages(
+    alloc: PageAllocator,
+    pages: list,
+    n_keep: int,
+) -> list[int]:
+    """Speculative-decode rollback: truncate a request's page list to its
+    first ``n_keep`` entries, releasing the tail back to the pool.
+
+    The verify step pre-provisions pages for the whole draft window
+    (write positions may run speculate_tokens past the accepted cursor);
+    after acceptance, pages covering ONLY rejected tokens are dead — no
+    position below the rewound cursor lives in them, and the next window's
+    provisioning re-allocates from the free list (LIFO, so the same pages
+    come straight back if speculation continues). Releasing them here
+    restores exactly the page footprint a non-speculative (window=1)
+    engine holds after its step, which is what keeps pool-pressure
+    preemption and the admission math speculation-agnostic.
+
+    Tail entries are always privately-owned (refcount 1): shared prefix
+    pages and SWA-rolled ``None`` placeholders live strictly below any
+    live cursor, hence below ``n_keep``. Returns the released page ids
+    (the caller zeroes their page-table columns).
+    """
+    assert n_keep >= 0, n_keep
+    dead = [p for p in pages[n_keep:] if p is not None]
+    del pages[n_keep:]
+    alloc.free(dead)
+    return dead
+
+
 def copy_page(cache: Cache, src, dst, *, n_layers: int, num_pages: int) -> Cache:
     """Copy one pool page's rows (all layers, all cache arrays) src -> dst.
 

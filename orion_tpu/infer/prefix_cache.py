@@ -83,6 +83,11 @@ class PrefixCache:
         # that node's pages). Kept in sync by lock/unlock/insert/evict/
         # clear; splits move pages between equal-lock nodes (no change).
         self.locked_pages = 0
+        # token_paths() memo: the path SET only changes on insert/evict/
+        # clear (splits preserve it), so the speculative proposer's
+        # per-step read is amortized to a dict lookup between mutations.
+        self._paths_version = 0
+        self._paths_cache: Optional[tuple[int, list]] = None
 
     # -- internals ---------------------------------------------------------
 
@@ -207,8 +212,42 @@ class PrefixCache:
             node.children[key[:psz]] = leaf
             node = leaf
             self.total_pages += added
+            self._paths_version += 1
         self._touch(node)
         return added
+
+    def token_paths(self, max_paths: int = 64):
+        """Root-to-leaf token sequences currently cached, most recently
+        used first (capped at ``max_paths``). Draft source for
+        speculative decoding (infer/spec_decode.py): a cached
+        system-prompt + answer path predicts continuations for requests
+        sharing the prefix, so the n-gram proposer can draft across
+        requests, not just from a request's own history. Read-only — no
+        locks taken, no stamps touched. Memoized between structural
+        mutations (insert/evict/clear), so the per-decode-step call costs
+        a version check, not a tree walk; recency ORDER within the memo
+        window is the mutation-time order, which is draft-priority
+        fidelity enough for a fallback source."""
+        if (
+            self._paths_cache is not None
+            and self._paths_cache[0] == self._paths_version
+        ):
+            return self._paths_cache[1]
+        paths: list[tuple[int, tuple]] = []
+
+        def walk(node: _Node, prefix: tuple) -> None:
+            run = prefix + node.key
+            if not node.children and run:
+                paths.append((node.stamp, run))
+                return
+            for child in node.children.values():
+                walk(child, run)
+
+        walk(self.root, ())
+        paths.sort(key=lambda sp: -sp[0])
+        out = [p for _, p in paths[:max_paths]]
+        self._paths_cache = (self._paths_version, out)
+        return out
 
     def evictable_pages(self) -> int:
         """Pages reclaimable right now: every page in a subtree no live
@@ -240,6 +279,8 @@ class PrefixCache:
                 freed += 1
             if not leaf.pages:
                 del leaf.parent.children[first]
+        if freed:
+            self._paths_version += 1
         return freed
 
     def clear(self) -> int:
@@ -260,4 +301,6 @@ class PrefixCache:
         self.root = _Node((), [], None)
         self.total_pages = 0
         self.locked_pages = 0
+        self._paths_version += 1
+        self._paths_cache = None
         return released

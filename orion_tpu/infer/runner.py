@@ -359,7 +359,14 @@ def _decode_layer(
     mesh: Optional[jax.sharding.Mesh],
 ) -> tuple[jax.Array, Cache]:
     """One transformer layer of single-token decode: fused-write ragged
-    paged attention (pallas) or scatter + masked pool gather (xla)."""
+    paged attention (pallas) or scatter + masked pool gather (xla).
+
+    LOCKSTEP: _verify_layer is this body's xla branch generalized from 1
+    to W queries per slot, and speculative byte-identity (greedy spec-on
+    == spec-off, enforced by tests/test_spec_decode.py across kv_quant /
+    SWA / prefix-cache compositions) holds only while the two agree
+    op-for-op on the write/gather/dequant/mask math — fix both together.
+    """
     B, psz, NP, P = ctx["B"], ctx["psz"], ctx["NP"], ctx["P"]
     quant = ctx["quant"]
     write_pos, page_table = ctx["write_pos"], ctx["page_table"]
@@ -511,6 +518,213 @@ def decode_window(
     return toks, cache
 
 
+def _verify_ctx(
+    cache: Cache,
+    seq_lens: jax.Array,      # [B] accepted-token cursor per slot
+    lens: jax.Array,          # [B] real verify tokens this row (1..W)
+    page_table: jax.Array,    # [B, pages_per_seq]
+    active: jax.Array,        # [B] bool
+    W: int,
+    max_seq_len: int,
+    cfg: ModelConfig,
+) -> dict:
+    """Batch-level tensors for the verify body (speculative decoding).
+
+    Row b holds ``lens[b]`` real tokens — the pending last token plus its
+    drafts — writing KV at positions ``seq_lens[b] + j``. Unlike prefill
+    chunks these start MID-PAGE (the cursor is arbitrary), so per-token
+    (page, offset) pairs come from the page table exactly as decode's do;
+    unlike decode there are W of them per row. Padding positions (j >=
+    lens, inactive rows, past max_seq_len) scatter to scratch page 0 —
+    never clamped onto a real page, so a row near the context limit cannot
+    clobber its own final KV slot the way a clamp would.
+    """
+    B = seq_lens.shape[0]
+    kp = cache["k"]
+    psz = kp.shape[2]
+    NP = kp.shape[0] // cfg.n_layers
+    P = page_table.shape[1]
+    batch_idx = jnp.arange(B)[:, None]
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    q_pos = seq_lens[:, None] + steps                       # [B, W] true
+    wp = jnp.minimum(q_pos, max_seq_len - 1)                # in-bounds
+    valid = (
+        active[:, None] & (steps < lens[:, None]) & (q_pos < max_seq_len)
+    )
+    page_idx = jnp.where(
+        valid, page_table[batch_idx, wp // psz], 0
+    )                                                       # [B, W]
+    offset = wp % psz
+    # KV positions each query may attend AFTER the row's writes land:
+    # everything at or before the query's own position (earlier drafts in
+    # the same dispatch included — they sit at positions seq_lens..q_pos).
+    kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    kv_base_mask = kv_arange <= q_pos[:, :, None]           # [B, W, P*psz]
+    return dict(
+        B=B, W=W, psz=psz, NP=NP, P=P, quant="k_scale" in cache,
+        page_table=page_table, positions=wp, q_pos=q_pos,
+        page_idx=page_idx, offset=offset,
+        kv_arange=kv_arange, kv_base_mask=kv_base_mask,
+    )
+
+
+def _verify_layer(
+    x: jax.Array,
+    cc: Cache,
+    bp: Any,
+    l,
+    j: int,
+    ctx: dict,
+    cfg: ModelConfig,
+    mesh: Optional[jax.sharding.Mesh],
+) -> tuple[jax.Array, Cache]:
+    """One transformer layer of batched draft verification: the decode
+    body's scatter-then-masked-gather generalized from one query to W per
+    slot — every draft position's K/V lands in the pool first (quantized
+    under kv_quant, exactly as a sequential decode would have written it),
+    then each query attends the gathered context up to its own position.
+    One pass over this layer's weights serves all W positions of all
+    slots; position i's logits therefore match the i-th sequential decode
+    step's bit-for-bit, which is what makes greedy acceptance exact.
+
+    LOCKSTEP: this is _decode_layer's xla branch with a W dimension —
+    any change to either body's write/gather/dequant/mask math must land
+    in both, or the greedy spec-on == spec-off equivalence suite
+    (tests/test_spec_decode.py) fails."""
+    B, W, psz, NP, P = ctx["B"], ctx["W"], ctx["psz"], ctx["NP"], ctx["P"]
+    quant = ctx["quant"]
+    page_table = ctx["page_table"]
+    page_idx, offset = ctx["page_idx"], ctx["offset"]
+    cc = dict(cc)
+    win = cfg.layer_window(j)
+    h = _norm(x, bp["attn_norm"], cfg)
+    q, k, v = qkv_proj(h, bp["attn"], cfg, ctx["positions"])
+    K, H = k.shape[2], k.shape[3]
+    rows = l * NP + page_idx                       # [B, W]
+    if quant:
+        from orion_tpu.infer.kv_cache import quantize_kv
+
+        kq, ks = quantize_kv(k)                    # [B,W,K,H] i8, [B,W,K]
+        vq, vs = quantize_kv(v)
+        cc["k"] = cc["k"].at[rows, :, offset].set(kq)
+        cc["v"] = cc["v"].at[rows, :, offset].set(vq)
+        cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
+        cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
+    else:
+        cc["k"] = cc["k"].at[rows, :, offset].set(k)
+        cc["v"] = cc["v"].at[rows, :, offset].set(v)
+    # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather (the
+    # just-written draft K/V reads back out of the pool, so under kv_quant
+    # each query attends its drafts DEQUANTIZED — the decode path's exact
+    # numerics).
+    k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+    v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+    if quant:
+        ksc = cc["k_scale"][l * NP + page_table][..., :psz]
+        vsc = cc["v_scale"][l * NP + page_table][..., :psz]
+        k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
+            0, 1, 3, 2)[..., None]
+        v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
+            0, 1, 3, 2)[..., None]
+        k_ctx = k_ctx.astype(q.dtype)
+        v_ctx = v_ctx.astype(q.dtype)
+    k_ctx = k_ctx.reshape(B, P * psz, K, H)
+    v_ctx = v_ctx.reshape(B, P * psz, K, H)
+    kv_mask = ctx["kv_base_mask"]
+    if win is not None:
+        kv_mask = kv_mask & (
+            ctx["kv_arange"] >= (ctx["q_pos"] - win + 1)[:, :, None]
+        )
+    out = attention_xla(
+        q, k_ctx, v_ctx, causal=False, mask=kv_mask,
+        logit_softcap=cfg.attn_logit_softcap,
+    )
+    a = out_proj(out, bp["attn"], cfg)
+    if cfg.post_norms:
+        a = _norm(a, bp["post_attn_norm"], cfg)
+    x = x + a
+    h2 = _norm(x, bp["mlp_norm"], cfg)
+    y, _ = mlp_or_moe(h2, bp, cfg)
+    if cfg.post_norms:
+        y = _norm(y, bp["post_mlp_norm"], cfg)
+    return x + y, cc
+
+
+def _draft_next(tokens: jax.Array, lens: jax.Array) -> jax.Array:
+    """[B, W] draft-under-check per logits position: position j's logits
+    predict the token at j+1, so they check ``tokens[:, j+1]`` — or
+    nothing (-1: the row's bonus/correction position, and all padding)."""
+    B, W = tokens.shape
+    shifted = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((B, 1), -1, jnp.int32)], axis=1
+    )
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    return jnp.where(steps + 1 < lens[:, None], shifted, -1)
+
+
+def verify_step(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B, W]: pending last token + its drafts
+    seq_lens: jax.Array,      # [B] int32 accepted-token cursor
+    lens: jax.Array,          # [B] int32 real verify tokens (1..W)
+    page_table: jax.Array,    # [B, pages_per_seq] int32
+    active: jax.Array,        # [B] bool: slot holds a live decode request
+    key: jax.Array,           # PRNG key (sampled acceptance draws)
+    temperature: jax.Array,   # [B] f32 per-request sampling params
+    top_k: jax.Array,         # [B] i32   (python scalars for the all-
+    top_p: jax.Array,         # [B] f32    defaults greedy specialization)
+    cfg: ModelConfig,
+    max_seq_len: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> tuple[jax.Array, jax.Array, Cache]:
+    """Score K drafts for EVERY live slot in ONE dispatch (speculative
+    decoding's verification half; drafting is infer/spec_decode.py).
+
+    Structurally the [W, B] decode-window shape turned sideways: W = max
+    drafts + 1 positions per slot in a single forward pass instead of W
+    sequential passes — ONE pass over the weights emits up to W tokens per
+    slot, which is the whole speculative bargain. Per-slot real lengths
+    ride in ``lens`` (the dispatch width is static at speculate_tokens+1;
+    shorter rows pad, and padding positions write to scratch page 0).
+    Draft KV is written INTO the paged pool as it goes — accepted
+    positions' KV is already in place, so acceptance costs nothing; the
+    engine rewinds rejected positions afterwards (cursor retreat + page
+    release, kv_cache.rollback_pages) and the garbage beyond the rewound
+    cursor is masked by seq_lens exactly like decode-window overshoot.
+
+    Returns ``(accept [B, W] bool, alt [B, W] int32, cache)`` — the
+    per-position acceptance verdicts and fallback tokens of
+    sampling.spec_verify_sample; the engine walks each row to its first
+    rejection and emits ``accepted drafts + one bonus/correction token``.
+
+    The body is the XLA decode path (scatter + masked gather) on BOTH
+    kernel settings: the ragged paged-attention kernel is single-query
+    with a fused single-token write, and a multi-query variant is a
+    kernel project of its own (PERF.md). The gather costs what an xla
+    decode step costs — paid once per W tokens instead of once per token.
+    """
+    from orion_tpu.infer.sampling import spec_verify_sample
+
+    W = tokens.shape[1]
+    ctx = _verify_ctx(
+        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg
+    )
+
+    def body(carry, bp, l, j):
+        x, cc = carry
+        return _verify_layer(x, cc, bp, l, j, ctx, cfg, mesh)
+
+    x = embed(params, tokens, ctx["positions"], cfg)
+    x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
+    logits = unembed(params, x, cfg)                       # [B, W, V]
+    accept, alt = spec_verify_sample(
+        logits, _draft_next(tokens, lens), key,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+    return accept, alt, cache
+
+
 def mixed_step(
     params: Params,
     cache: Cache,
@@ -587,3 +801,69 @@ def mixed_step(
     )
     p_logits = _prefill_logits(params, xp, p_lengths, cfg)
     return toks, p_logits, cache
+
+
+def mixed_verify_step(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,        # [B, W]: pending last token + drafts per slot
+    seq_lens: jax.Array,      # [B] int32 accepted-token cursor
+    lens: jax.Array,          # [B] int32 real verify tokens (1..W)
+    page_table: jax.Array,    # [B, pages_per_seq] int32; mid-prefill slots
+    #                           carry all-zero rows (their writes -> scratch)
+    active: jax.Array,        # [B] bool: slot holds a DECODING request
+    key: jax.Array,           # PRNG key (sampled acceptance draws)
+    p_tokens: jax.Array,      # [Nc, S_chunk] prompt-chunk tail tokens
+    p_lengths: jax.Array,     # [Nc] int32: true chunk lengths
+    p_pages: jax.Array,       # [Nc, S_chunk // psz] pages the chunk writes
+    p_prefix_lens: jax.Array, # [Nc] int32: context tokens already in cache
+    p_prefix_pages: jax.Array,  # [Nc, P_pre] pages holding that context
+    temperature: jax.Array,   # [B] f32 per-request decode sampling params
+    top_k: jax.Array,         # [B] i32
+    top_p: jax.Array,         # [B] f32
+    *,
+    cfg: ModelConfig,
+    max_seq_len: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, Cache]:
+    """``mixed_step`` with the decode half replaced by the verify body:
+    speculative decoding composed with chunked prefill. One dispatch runs
+    up to the chunk budget of prompt tail (prompt-phase slots — they skip
+    drafting by construction, their prompts ARE the chunk rows) AND a
+    W-position draft verification for every decoding slot, over the same
+    carried pool and the same pass over the weights.
+
+    Returns ``(accept [B, W], alt [B, W], chunk_logits [Nc, V], cache)``.
+    Chunk rows and verify rows touch disjoint pages for the same reason
+    mixed_step's halves do: a slot is either prefilling (its verify row is
+    masked onto scratch by the engine's zeroed page-table copy) or
+    decoding (its pages are not in any chunk row), so the in-place pool
+    updates commute.
+    """
+    from orion_tpu.infer.sampling import spec_verify_sample
+
+    W = tokens.shape[1]
+    pctx = _prefill_ctx(
+        cache, p_tokens, p_lengths, p_pages, p_prefix_lens, p_prefix_pages,
+        cfg,
+    )
+    vctx = _verify_ctx(
+        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg
+    )
+
+    def body(carry, bp, l, j):
+        xp, xv, cc = carry
+        xp, cc = _prefill_layer(xp, cc, bp, l, j, pctx, cfg, mesh)
+        xv, cc = _verify_layer(xv, cc, bp, l, j, vctx, cfg, mesh)
+        return xp, xv, cc
+
+    xp = embed(params, p_tokens, pctx["positions"], cfg)
+    xv = embed(params, tokens, vctx["positions"], cfg)
+    xp, xv, cache = _scan_layers(params, cfg, body, (xp, xv, dict(cache)))
+    logits = unembed(params, xv, cfg)                      # [B, W, V]
+    accept, alt = spec_verify_sample(
+        logits, _draft_next(tokens, lens), key,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+    p_logits = _prefill_logits(params, xp, p_lengths, cfg)
+    return accept, alt, p_logits, cache

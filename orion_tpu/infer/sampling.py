@@ -53,6 +53,30 @@ def sample(
     top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = filter_logits(logits, temp, top_k, top_p,
+                           no_topk=no_topk, no_topp=no_topp)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+def filter_logits(
+    logits: jax.Array,     # [B, V] float32
+    temp: jax.Array,       # [B] f32 (rows <= 0 pass through at scale 1)
+    top_k: jax.Array,      # [B] i32
+    top_p: jax.Array,      # [B] f32
+    *,
+    no_topk: bool = False,
+    no_topp: bool = False,
+) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-masked logits [B, V].
+
+    The single definition of the target distribution: ``sample`` draws a
+    categorical from it, and speculative verification (spec_verify_sample)
+    measures draft-acceptance probabilities against softmax of the SAME
+    array — rejection sampling preserves the output distribution only if
+    both sides agree on it exactly.
+    """
+    B, V = logits.shape
     scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
 
     if not (no_topk and no_topp):
@@ -89,6 +113,74 @@ def sample(
         scaled = jnp.where(
             (top_p[:, None] < 1.0) & (scaled < thresh), NEG_INF, scaled
         )
+    return scaled
 
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temp > 0, sampled, greedy)
+
+def spec_verify_sample(
+    logits: jax.Array,       # [B, W, V] verify logits, position-major
+    draft_next: jax.Array,   # [B, W] i32: the draft token each position is
+    #                          checking (tokens[:, j+1]); -1 at bonus /
+    #                          padding positions (no draft to check)
+    key: jax.Array,
+    *,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-position draft acceptance for speculative decoding.
+
+    Returns ``(accept [B, W] bool, alt [B, W] int32)``. The host walks each
+    row's positions left to right: while ``accept[j]`` holds, draft j+1 is
+    emitted; at the first rejection (or at the row's bonus position)
+    ``alt[j]`` is emitted instead, and the rest of the row is discarded.
+
+    Greedy rows (temperature <= 0): accept is exact argmax match and alt
+    is the argmax — the emitted stream is byte-identical to non-speculative
+    greedy decoding. Sampled rows use standard rejection sampling against
+    the deterministic n-gram proposal q = delta(draft): accept with
+    probability p(draft) under the filtered target distribution p
+    (filter_logits — the same array ``sample`` draws from); on rejection,
+    alt is drawn from the residual max(0, p - q) normalized, i.e. p
+    conditioned on != draft; at the bonus position (draft_next < 0) alt is
+    a plain sample from p. The marginal law of every emitted token is
+    exactly p, so the served distribution is provably unchanged.
+
+    The all-scalar greedy case (python temperature <= 0) compiles to a bare
+    argmax + compare — no sort, no categorical (mirrors ``sample``'s
+    specialization contract).
+    """
+    B, W, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B, W]
+    if isinstance(temperature, (int, float)) and temperature <= 0.0:
+        return greedy == draft_next, greedy
+
+    flat = logits.reshape(B * W, V).astype(jnp.float32)
+    # Per-request params broadcast over the row's W positions.
+    rep = lambda a, dt: jnp.broadcast_to(  # noqa: E731
+        jnp.asarray(a, dt).reshape(-1, 1) if jnp.ndim(a) else
+        jnp.asarray(a, dt), (B, W)
+    ).reshape(B * W)
+    temp = rep(temperature, jnp.float32)
+    no_topk = isinstance(top_k, int) and top_k == 0
+    no_topp = isinstance(top_p, (int, float)) and top_p >= 1.0
+    filtered = filter_logits(
+        flat, temp, rep(top_k, jnp.int32), rep(top_p, jnp.float32),
+        no_topk=no_topk, no_topp=no_topp,
+    )
+    dn = draft_next.reshape(B * W)
+    probs = jax.nn.softmax(filtered, axis=-1)
+    p_draft = jnp.take_along_axis(
+        probs, jnp.clip(dn, 0, V - 1)[:, None], axis=-1
+    )[:, 0]
+    k_u, k_alt = jax.random.split(key)
+    u = jax.random.uniform(k_u, (B * W,))
+    # Residual on rejection: p excluding the rejected draft; the bonus
+    # position (dn < 0) excludes nothing (plain sample from p).
+    excl = (jnp.arange(V)[None, :] == dn[:, None]) & (dn >= 0)[:, None]
+    alt_s = jax.random.categorical(
+        k_alt, jnp.where(excl, NEG_INF, filtered), axis=-1
+    ).astype(jnp.int32)
+    g = greedy.reshape(B * W)
+    accept = jnp.where(temp > 0, u < p_draft, g == dn) & (dn >= 0)
+    alt = jnp.where(temp > 0, alt_s, g)
+    return accept.reshape(B, W), alt.reshape(B, W)

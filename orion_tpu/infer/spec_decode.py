@@ -1,0 +1,174 @@
+"""Draft-model-free speculative decoding: prompt-lookup n-gram proposer.
+
+Decode is memory-bandwidth-bound — one full pass over the weights per
+emitted token (see PERF.md "Serving"). Speculative decoding amortizes that
+pass: propose K likely continuation tokens per request on the HOST, verify
+all of them (plus the pending last token) in ONE device dispatch
+(runner.verify_step — structurally the multi-token machinery decode_window
+and mixed_step already proved out), and accept the matched prefix. On
+self-repetitive text (code, structured output, a model whose greedy
+continuation loops) one weight pass emits up to K+1 tokens.
+
+This module is the drafting half, deliberately model-free (prompt lookup,
+a.k.a. n-gram speculation): the last ``n`` tokens of a request's context
+are matched against earlier positions of the request's own prompt+output —
+and, when the prefix cache is on, against the radix tree's cached token
+paths (cross-request reuse: a cached system-prompt + answer path predicts
+the next request's continuation) — and the continuation of the most recent
+match is the draft. No draft model, no extra weights, no device work:
+drafting costs O(n_slots * ngram * context) python per step, which is
+noise next to a dispatch.
+
+Acceptance is computed by the engine from the verify logits: greedy
+acceptance is exact argmax match (spec-on output byte-identical to
+spec-off); sampled acceptance is rejection sampling against the filtered
+target distribution (sampling.spec_verify_sample), so the served
+distribution is provably unchanged.
+
+The per-request draft length adapts (``SpecState``): halve on a
+low-acceptance verify (wasted KV writes + rollback churn), double back on
+full acceptance, always within [1, speculate_tokens]. A request whose
+context has no n-gram match simply drafts nothing that step — and if NO
+slot drafts, the engine falls back to the plain decode window (speculation
+never costs a non-repetitive workload more than the proposal scan).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+
+def propose_ngram(
+    context: Sequence[int],
+    k: int,
+    *,
+    max_n: int = 3,
+    min_n: int = 1,
+    extra_sources: Iterable[Sequence[int]] = (),
+) -> list[int]:
+    """Up to ``k`` draft tokens continuing ``context`` by prompt lookup.
+
+    For ``n`` from ``max_n`` down to ``min_n``: find the MOST RECENT
+    earlier occurrence of the context's last ``n`` tokens — first inside
+    ``context`` itself, then in each of ``extra_sources`` (e.g. the prefix
+    cache's token paths) — and return the tokens that followed it. Longer
+    n-grams are tried first (a longer match is a stronger continuation
+    signal); the in-context match wins over external sources at equal n
+    (the request's own history is the better predictor of its own loop).
+    """
+    if k <= 0:
+        return []
+    L = len(context)
+    max_n = min(max_n, L - 1)
+    for n in range(max_n, min_n - 1, -1):
+        suffix = list(context[L - n:])
+        first = suffix[0]
+        # Most recent occurrence strictly before the suffix itself, so a
+        # continuation exists: context[i : i+n] == suffix with i+n < L.
+        # The hot loop is a first-token compare per position (no slice
+        # allocation); the full n-gram compare runs only on candidates —
+        # this scan sits on the ITL-critical host path every decode step.
+        for i in range(L - n - 1, -1, -1):
+            if context[i] == first and list(context[i:i + n]) == suffix:
+                return list(context[i + n:i + n + k])
+        for src in extra_sources:
+            S = len(src)
+            for i in range(S - n - 1, -1, -1):
+                if src[i] == first and list(src[i:i + n]) == suffix:
+                    return list(src[i + n:i + n + k])
+    return []
+
+
+@dataclass
+class SpecState:
+    """Per-request adaptive draft length + lifetime acceptance counters.
+
+    ``miss_streak``/``cooldown`` back the proposal-scan throttle: the
+    n-gram scan is O(context) host work, and the workload that never
+    matches is exactly the one that gains nothing from paying it every
+    step. The first three misses rescan every step — right after prefill
+    is when a repetition first establishes, so early throttling would
+    delay real draft onset — then consecutive misses back off linearly
+    (skip ``min(miss_streak - 3, 8)`` steps before rescanning), bounding
+    steady-state non-repetitive traffic at ~1/8th of the scan cost; a
+    hit resets the streak. The throttle never changes emitted tokens
+    (speculation is output-invariant by construction)."""
+
+    draft_len: int
+    drafted: int = 0
+    accepted: int = 0
+    miss_streak: int = 0
+    cooldown: int = 0
+
+    def update(self, drafted: int, accepted: int, cap: int) -> None:
+        """Adapt after one verify: halve on low acceptance (< half the
+        drafts landed — the rejected tail is pure rollback churn), double
+        back on full acceptance, clamp to [1, cap]. No-draft steps leave
+        the length untouched (nothing was learned)."""
+        if drafted <= 0:
+            return
+        self.drafted += drafted
+        self.accepted += accepted
+        if accepted >= drafted:
+            self.draft_len = min(self.draft_len * 2, cap)
+        elif accepted * 2 < drafted:
+            self.draft_len = max(self.draft_len // 2, 1)
+
+
+class NgramProposer:
+    """Engine-facing proposer: owns the n-gram parameters and the
+    per-request SpecState table (keyed by rid; dropped when the request
+    leaves — a preempted request that re-enters restarts its adaptation
+    from the configured cap, matching its re-prefilled cold start)."""
+
+    def __init__(self, *, speculate_tokens: int, max_n: int, min_n: int):
+        if speculate_tokens < 1:
+            raise ValueError(
+                f"speculate_tokens must be >= 1, got {speculate_tokens}"
+            )
+        if not 1 <= min_n <= max_n:
+            raise ValueError(
+                f"need 1 <= spec_ngram_min <= spec_ngram_max, got "
+                f"[{min_n}, {max_n}]"
+            )
+        self.cap = speculate_tokens
+        self.max_n = max_n
+        self.min_n = min_n
+        self._states: dict[int, SpecState] = {}
+
+    def state(self, rid: int) -> SpecState:
+        st = self._states.get(rid)
+        if st is None:
+            st = self._states[rid] = SpecState(draft_len=self.cap)
+        return st
+
+    def drop(self, rid: int) -> None:
+        self._states.pop(rid, None)
+
+    def propose(
+        self,
+        rid: int,
+        context: Sequence[int],
+        limit: int,
+        extra_sources: Iterable[Sequence[int]] = (),
+    ) -> list[int]:
+        """Draft for one request: n-gram lookup capped by the adaptive
+        per-request length AND the caller's ``limit`` (context-window /
+        budget headroom), throttled after consecutive misses (see
+        SpecState)."""
+        st = self.state(rid)
+        if st.cooldown > 0:
+            st.cooldown -= 1
+            return []
+        k = min(st.draft_len, limit)
+        d = propose_ngram(
+            context, k, max_n=self.max_n, min_n=self.min_n,
+            extra_sources=extra_sources,
+        )
+        if d:
+            st.miss_streak = 0
+        else:
+            st.miss_streak += 1
+            st.cooldown = max(0, min(st.miss_streak - 3, 8))
+        return d

@@ -107,6 +107,51 @@ class PrefixCacheStats:
 
 
 @dataclass
+class SpecDecodeStats:
+    """Speculative-decoding counters (inference.speculative), owned by
+    InferenceEngine and drained through ``reset_timing``.
+
+    ``drafted``/``accepted``/``rolled_back`` count DRAFT tokens (proposed /
+    matched-and-emitted / rejected-and-rewound; rolled_back == drafted -
+    accepted by construction). ``verify_steps`` counts verify dispatches,
+    ``verify_slot_steps`` (verify dispatches x live decode slots) the
+    per-slot dispatch opportunities, and ``emitted`` every token a verify
+    step emitted (accepted drafts + the per-slot bonus/correction token) —
+    so ``emitted / verify_slot_steps`` is the decode tokens-per-dispatch
+    the speculation bought (1.0 means it bought nothing)."""
+
+    drafted: int = 0
+    accepted: int = 0
+    rolled_back: int = 0
+    emitted: int = 0
+    verify_steps: int = 0
+    verify_slot_steps: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def tokens_per_verify(self) -> float:
+        if not self.verify_slot_steps:
+            return 0.0
+        return self.emitted / self.verify_slot_steps
+
+    def as_timing(self) -> dict[str, float]:
+        """Flatten into the engine's reset_timing dict."""
+        return {
+            "spec_drafted": self.drafted,
+            "spec_accepted": self.accepted,
+            "spec_rolled_back": self.rolled_back,
+            "spec_emitted": self.emitted,
+            "spec_acceptance_rate": self.acceptance_rate,
+            "verify_steps": self.verify_steps,
+            "verify_slot_steps": self.verify_slot_steps,
+            "spec_tokens_per_verify": self.tokens_per_verify,
+        }
+
+
+@dataclass
 class LatencyStats:
     """Streaming latency collector for the serving benches (SURVEY.md §6
     metrics): record per-event wall times (TTFT, inter-token gaps), report

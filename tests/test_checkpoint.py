@@ -1,0 +1,62 @@
+"""Checkpoint manager regressions (ckpt/checkpoint.py).
+
+The stream-format stamp (round 5) records the data-stream mapping of the
+latest COMMITTED save. With async_save the stamp used to land only at the
+wait()/close() barrier — a long run that crashed mid-run left every
+committed checkpoint unstamped, and resume warned "written before round
+5" spuriously (ADVICE r5). save() now flushes the pending stamp at the
+start of the NEXT save once the prior async save has committed, bounding
+the stamp lag to one save interval.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+ocp = pytest.importorskip("orbax.checkpoint")
+
+from orion_tpu.ckpt import CheckpointManager          # noqa: E402
+from orion_tpu.config import CheckpointConfig         # noqa: E402
+
+
+def _state():
+    return {"a": jnp.arange(4, dtype=jnp.float32)}
+
+
+def test_async_stamp_flushes_at_next_save(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, CheckpointConfig(async_save=True))
+    assert mgr.save(0, _state(), force=True)
+    # A NON-saving call (interval not due, no force) must not flush: the
+    # trainer calls save() every step, and flushing there would block the
+    # training loop on the async commit it exists to hide.
+    stamp = os.path.join(d, "stream_format.json")
+    assert not mgr.save(1, _state())     # interval 1000: not due
+    assert getattr(mgr, "_stamp_pending", False)
+    # The first async save alone may not have stamped yet (commit is
+    # asynchronous; the stamp belongs to committed checkpoints only).
+    # The SECOND save must flush the first save's pending stamp before
+    # dispatching its own work — one save interval of lag, not the whole
+    # run.
+    assert mgr.save(1, _state(), force=True)
+    assert os.path.exists(stamp), "stamp not flushed by the next save()"
+    with open(stamp) as f:
+        saved = json.load(f)["stream_format"]
+    from orion_tpu.data.loader import STREAM_FORMAT
+
+    assert saved == STREAM_FORMAT
+    # The second save's own stamp is pending again, flushed at the
+    # wait()/close() barrier as before.
+    assert getattr(mgr, "_stamp_pending", False)
+    mgr.close()
+    assert not getattr(mgr, "_stamp_pending", True)
+
+
+def test_sync_stamp_lands_inline(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, CheckpointConfig(async_save=False))
+    assert mgr.save(0, _state(), force=True)
+    assert os.path.exists(os.path.join(d, "stream_format.json"))
+    mgr.close()

@@ -1,0 +1,395 @@
+"""Speculative decoding: prompt-lookup drafts + batched verification
+(ISSUE 3).
+
+The load-bearing property is EQUIVALENCE (mirroring the prefix-cache and
+chunked-prefill suites): with inference.speculative on, GREEDY served
+tokens must be byte-identical to the non-speculative engine's — the
+verify body writes each draft position's KV exactly as a sequential
+decode would have and acceptance is exact argmax match — across plain
+decode, kv_quant=int8, sliding windows, prefix-cache rows, chunked
+prefill (mixed verify steps), tp-sharded pools, and mid-stream preemption
+with rollback. Sampled acceptance is rejection sampling: the per-token
+OUTPUT DISTRIBUTION is unchanged (pinned statistically at the sampling
+unit), while the stream itself draws from a different key sequence.
+
+Rollback is pinned structurally: after every speculative step a live
+slot's page footprint equals the non-speculative window=1 engine's
+(cursor-covering pages only), and at drain the allocator state matches
+exactly (free set + refcounts) — rejected drafts leave no residue.
+
+The workload prompts are short cycles: the fixed-seed tiny model's greedy
+continuation locks into a loop, which the n-gram proposer then drafts —
+the canonical speculative win, and a deterministic one for CI.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import get_config
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.infer.spec_decode import SpecState, propose_ngram
+from orion_tpu.models import init_params
+
+INFER_OVERRIDES = [
+    "inference.max_seq_len=128",
+    "inference.page_size=16",
+    "inference.num_pages=32",
+    "inference.max_batch_size=4",
+    "inference.prefill_chunk=16",
+    "inference.max_new_tokens=8",
+    "inference.decode_window=1",
+]
+SPEC = [
+    "inference.speculative=true",
+    "inference.speculate_tokens=4",
+]
+
+# Cyclic prompts -> looping greedy continuations on the seed-0 tiny model.
+REP = [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8]
+MIX = [REP, [5, 3, 9, 250, 17], list(range(2, 32))]
+
+
+def _setup(preset="tiny-llama", overrides=(), spec=True):
+    ov = INFER_OVERRIDES + (SPEC if spec else []) + list(overrides)
+    cfg = get_config(preset, ov)
+    params = init_params(cfg.model, jax.random.key(0))
+    return cfg, params
+
+
+def test_spec_default_off_and_validation():
+    cfg, params = _setup(spec=False)
+    assert cfg.inference.speculative is False
+    eng = InferenceEngine(cfg, params)
+    assert eng._spec is None
+    bad, _ = _setup(overrides=["inference.speculate_tokens=0"])
+    with pytest.raises(ValueError, match="speculate_tokens"):
+        InferenceEngine(bad, params)
+    bad2, _ = _setup(overrides=["inference.spec_ngram_min=3",
+                                "inference.spec_ngram_max=2"])
+    with pytest.raises(ValueError, match="spec_ngram"):
+        InferenceEngine(bad2, params)
+
+
+def test_ngram_proposer_unit():
+    # Longest n-gram wins: suffix (2, 3) continues with 9 at its earlier
+    # occurrence even though suffix (3,) alone would continue with 4.
+    ctx = [1, 2, 3, 9, 5, 3, 4, 2, 3]
+    assert propose_ngram(ctx, 2, max_n=3, min_n=1) == [9, 5]
+    # Most RECENT occurrence preferred at equal n.
+    ctx2 = [1, 2, 7, 5, 1, 2, 8, 5, 1, 2]
+    assert propose_ngram(ctx2, 1, max_n=2, min_n=1) == [8]
+    # Truncated at the source's end; never longer than k.
+    assert propose_ngram([4, 6, 4], 5, max_n=1, min_n=1) == [6, 4]
+    # No match -> no draft.
+    assert propose_ngram([1, 2, 3, 4], 4, max_n=3, min_n=2) == []
+    # External sources (prefix-cache paths) draft when the context misses.
+    assert propose_ngram(
+        [9, 1, 2], 3, max_n=2, min_n=1,
+        extra_sources=[(5, 1, 2, 6, 7, 8)],
+    ) == [6, 7, 8]
+    # Adaptive length: halve on low acceptance, double back on full.
+    st = SpecState(draft_len=4)
+    st.update(4, 1, cap=4)
+    assert st.draft_len == 2
+    st.update(2, 2, cap=4)
+    assert st.draft_len == 4
+    st.update(4, 4, cap=4)
+    assert st.draft_len == 4            # capped
+    st.update(0, 0, cap=4)
+    assert st.draft_len == 4            # no-draft step learns nothing
+    # Miss backoff: consecutive no-match scans skip ahead linearly, so a
+    # non-repetitive request doesn't pay the O(context) scan every step.
+    from orion_tpu.infer.spec_decode import NgramProposer
+
+    pr = NgramProposer(speculate_tokens=4, max_n=3, min_n=1)
+    flat = list(range(100, 140))        # no n-gram ever repeats
+    scans = [pr.propose(1, flat, 4) for _ in range(12)]
+    assert all(d == [] for d in scans)
+    s = pr.state(1)
+    assert s.miss_streak < 12           # throttle skipped real scans
+    assert s.cooldown >= 0
+    # A hit resets the streak and drafting resumes immediately.
+    pr.state(1).cooldown = 0
+    assert pr.propose(1, [7, 8, 9, 7, 8], 2) == [9, 7]
+    assert pr.state(1).miss_streak == 0
+
+
+def test_equivalence_greedy_and_counters():
+    """Greedy spec-on byte-identical to spec-off on looping + non-looping
+    prompts admitted together, with the acceptance counters surfaced
+    through reset_timing and a real amortization on the looping load."""
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(spec=False)
+    ref = InferenceEngine(cfg_off, params).generate(MIX, 24)
+    eng = InferenceEngine(cfg_on, params)
+    assert eng.generate(MIX, 24) == ref
+    t = eng.reset_timing()
+    assert t["verify_steps"] > 0, t
+    assert t["spec_drafted"] > 0, t
+    assert t["spec_accepted"] > 0, t
+    assert t["spec_rolled_back"] == t["spec_drafted"] - t["spec_accepted"]
+    assert t["spec_tokens_per_verify"] > 1.3, t
+
+
+def test_rollback_state_exact():
+    """KV/page state after rollback is exactly the non-speculative state:
+    mid-run every live slot holds only its cursor-covering pages (the
+    window=1 footprint), and at drain the allocator free set and
+    refcounts match the spec-off engine's exactly."""
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(spec=False)
+    prompts = [REP, list(range(2, 32))]
+
+    eng = InferenceEngine(cfg_on, params)
+    for p in prompts:
+        eng.submit(p, 20)
+    while eng.has_work():
+        eng.step()
+        for r in eng.slots:
+            if r is not None and not r.done:
+                want = (int(eng.seq_lens[r.slot]) - 1) // eng.psz + 1
+                assert len(r.pages) == want, (len(r.pages), want)
+    ref = InferenceEngine(cfg_off, params)
+    ref.generate(prompts, 20)
+    assert sorted(eng.alloc._free) == sorted(ref.alloc._free)
+    assert eng.alloc._refs == ref.alloc._refs
+    assert all(n == 0 for n in eng.alloc._refs)
+
+
+def test_spec_verify_sample_rejection_statistics():
+    """Rejection sampling preserves the target distribution: over many
+    keys, the emitted token (draft if accepted, else the residual sample)
+    is distributed as softmax(logits/T) — acceptance frequency matches
+    p(draft) and the emission law matches p within Monte-Carlo noise."""
+    from orion_tpu.infer.sampling import spec_verify_sample
+
+    V = 8
+    logits = jax.random.normal(jax.random.key(2), (1, 1, V)) * 2.0
+    temp = 0.7
+    p = np.asarray(jax.nn.softmax(np.asarray(logits[0, 0]) / temp))
+    draft = int(np.argsort(p)[-2])          # second-likeliest as the draft
+    dn = jax.numpy.asarray([[draft]], dtype=jax.numpy.int32)
+
+    run = jax.jit(
+        lambda k: spec_verify_sample(logits, dn, k, temperature=temp)
+    )
+    N = 4000
+    keys = jax.random.split(jax.random.key(3), N)
+    acc, alt = jax.vmap(run)(keys)
+    acc = np.asarray(acc)[:, 0, 0]
+    alt = np.asarray(alt)[:, 0, 0]
+    emitted = np.where(acc, draft, alt)
+    assert abs(acc.mean() - p[draft]) < 0.03, (acc.mean(), p[draft])
+    emp = np.bincount(emitted, minlength=V) / N
+    tv = 0.5 * np.abs(emp - p).sum()
+    assert tv < 0.04, (tv, emp, p)
+    # Residual never re-emits the rejected draft.
+    assert not np.any(alt[~acc] == draft)
+    # Bonus position (no draft): a plain sample from p.
+    dn_bonus = jax.numpy.full((1, 1), -1, jax.numpy.int32)
+    runb = jax.jit(
+        lambda k: spec_verify_sample(logits, dn_bonus, k, temperature=temp)
+    )
+    accb, altb = jax.vmap(runb)(keys)
+    assert not np.asarray(accb).any()       # nothing to accept
+    empb = np.bincount(np.asarray(altb)[:, 0, 0], minlength=V) / N
+    assert 0.5 * np.abs(empb - p).sum() < 0.04
+
+
+@pytest.mark.slow
+def test_sampled_engine_accept_path():
+    """Sampled serving through the rejection-sampling verify path:
+    temperature>0 with top_k=1 is argmax-deterministic, so the spec-on
+    stream must equal spec-off byte-for-byte while accepts flow through
+    the u < p(draft) machinery (p(draft) is 0 or 1 here)."""
+    sam = ["inference.temperature=0.9", "inference.top_k=1"]
+    cfg_on, params = _setup(overrides=sam)
+    cfg_off, _ = _setup(overrides=sam, spec=False)
+    a = InferenceEngine(cfg_on, params, seed=5)
+    assert a.generate([REP], 20) == (
+        InferenceEngine(cfg_off, params, seed=5).generate([REP], 20)
+    )
+    t = a.reset_timing()
+    assert t["spec_drafted"] > 0 and t["spec_accepted"] > 0, t
+
+
+@pytest.mark.slow
+def test_eos_mid_acceptance():
+    """EOS surfacing inside an accepted draft run stops the request at
+    the EOS token exactly as sequential decoding would."""
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(spec=False)
+    free = InferenceEngine(cfg_off, params).generate([REP], 20)[0]
+    eos = free[6]                # falls inside the looping (drafted) region
+    ref = InferenceEngine(cfg_off, params, eos_id=eos).generate([REP], 20)
+    eng = InferenceEngine(cfg_on, params, eos_id=eos)
+    assert eng.generate([REP], 20) == ref
+
+
+@pytest.mark.slow
+def test_equivalence_kv_quant():
+    """int8 KV pool: verify writes quantized draft KV and every query
+    attends it dequantized — the sequential decode numerics exactly."""
+    q = ["inference.kv_quant=int8"]
+    cfg_on, params = _setup(overrides=q)
+    cfg_off, _ = _setup(overrides=q, spec=False)
+    assert InferenceEngine(cfg_on, params).generate(MIX, 16) == (
+        InferenceEngine(cfg_off, params).generate(MIX, 16)
+    )
+
+
+@pytest.mark.slow
+def test_equivalence_sliding_window():
+    """SWA: verify queries window their own positions per layer, and the
+    page roll follows the rewound cursor."""
+    swa = ["model.sliding_window=20"]
+    cfg_on, params = _setup(overrides=swa)
+    cfg_off, _ = _setup(overrides=swa, spec=False)
+    assert InferenceEngine(cfg_on, params).generate(MIX, 16) == (
+        InferenceEngine(cfg_off, params).generate(MIX, 16)
+    )
+
+
+@pytest.mark.slow
+def test_equivalence_prefix_cache():
+    """Spec x prefix cache: warm rows speculate over shared pages (the
+    rollback never touches them — tail pages are private by construction)
+    and the radix tree's cached paths serve as draft sources."""
+    pc = ["inference.prefix_cache=true"]
+    cfg_on, params = _setup(overrides=pc)
+    cfg_off, _ = _setup(overrides=pc, spec=False)
+    eng_on = InferenceEngine(cfg_on, params)
+    eng_off = InferenceEngine(cfg_off, params)
+    assert eng_on.generate(MIX, 16) == eng_off.generate(MIX, 16)
+    # Warm round: matched prefixes map in AND speculation still matches.
+    assert eng_on.generate(MIX, 16) == eng_off.generate(MIX, 16)
+    t = eng_on.reset_timing()
+    assert t["prefix_hits"] >= 1, t
+    assert t["spec_accepted"] > 0, t
+    # The cached paths are exposed to the proposer.
+    paths = eng_on._pcache.token_paths()
+    assert paths and all(len(p) % eng_on.psz == 0 for p in paths)
+
+
+@pytest.mark.slow
+def test_equivalence_chunked_prefill():
+    """Spec x chunked prefill: decode-phase slots speculate through the
+    mixed verify step while a long prompt chunks alongside; prompt-phase
+    slots never draft; tokens equal the spec-off chunked engine's."""
+    ch = ["inference.chunked_prefill=true",
+          "inference.prefill_chunk_tokens=16"]
+    cfg_on, params = _setup(overrides=ch)
+    cfg_off, _ = _setup(overrides=ch, spec=False)
+
+    def run(cfg):
+        eng = InferenceEngine(cfg, params)
+        out = {}
+        eng.submit(REP, 24)
+        eng.step()
+        eng.step()                      # REP decoding (and speculating)
+        eng.submit(list(range(1, 97)), 4)   # 96-token prompt chunks in
+        while eng.has_work():
+            for r in eng.step():
+                out[r.rid] = r.generated
+        return out, eng
+
+    got, eng = run(cfg_on)
+    ref, _ = run(cfg_off)
+    assert got == ref
+    t = eng.reset_timing()
+    assert t["mixed_steps"] > 0, t
+    assert t["spec_accepted"] > 0, t    # speculation ran during the mix
+
+
+@pytest.mark.slow
+def test_equivalence_tp_sharded_pallas(cpu_devices):
+    """Spec x tp-sharded KV pool x Pallas serving: drafting/verification
+    over the head-sharded pool; tokens equal the unsharded spec-off
+    engine's."""
+    import dataclasses
+
+    from orion_tpu.config import ParallelConfig
+    from orion_tpu.models.transformer import param_logical_axes
+    from orion_tpu.parallel.sharding import param_shardings
+    from orion_tpu.runtime import build_mesh
+
+    cfg_on, params = _setup()
+    cfg_off, _ = _setup(spec=False)
+    pcfg_on = dataclasses.replace(
+        cfg_on, model=dataclasses.replace(cfg_on.model,
+                                          kernels="pallas_interpret")
+    )
+    pcfg_off = dataclasses.replace(
+        cfg_off, model=dataclasses.replace(cfg_off.model,
+                                           kernels="pallas_interpret")
+    )
+    prompts = [REP, [5, 3, 9, 250, 17]]
+    ref = InferenceEngine(pcfg_off, params).generate(prompts, 8)
+
+    mesh = build_mesh(ParallelConfig(tp=2), devices=cpu_devices[:2])
+    shardings = param_shardings(mesh, param_logical_axes(cfg_on.model))
+    sharded = jax.device_put(params, shardings)
+    eng = InferenceEngine(pcfg_on, sharded)
+    assert eng.mesh is not None
+    assert eng.generate(prompts, 8) == ref
+    assert eng.reset_timing()["spec_accepted"] > 0
+
+
+@pytest.mark.slow
+def test_preemption_mid_stream_rollback():
+    """Pool pressure preempts the youngest request while speculation is
+    in flight: the verify step's own page provisioning triggers the
+    preemption, the victim donates only cursor-valid pages (never
+    rejected-draft garbage), requeues, resumes, and every request still
+    produces its solo tokens exactly."""
+    ov = ["inference.num_pages=14", "inference.prefix_cache=true"]
+    cfg_on, params = _setup(overrides=ov)
+    cfg_off, _ = _setup(overrides=["inference.num_pages=14"], spec=False)
+    prompts = [[(i * 7) % 250 + 1 for i in range(16)],
+               [(i * 11) % 250 + 1 for i in range(16)],
+               [7, 8, 9] * 5 + [7]]
+    new = [60, 60, 60]
+    singles = [
+        InferenceEngine(cfg_off, params).generate([p], n)[0]
+        for p, n in zip(prompts, new)
+    ]
+    eng = InferenceEngine(cfg_on, params)
+    rids = [eng.submit(p, n) for p, n in zip(prompts, new)]
+    out = {}
+    while eng.has_work():
+        for r in eng.step():
+            out[r.rid] = r.generated
+    assert [out[rid] for rid in rids] == singles
+    assert eng.preemptions >= 1, "scenario failed to exercise preemption"
+    t = eng.reset_timing()
+    assert t["spec_drafted"] > 0, t
+
+
+def test_bench_smoke():
+    """tools/spec_decode_bench.py --smoke (the tier-1 wiring): greedy
+    spec-on/off streams identical and the self-repetitive workload shows
+    > 1.3 decode tokens per verify dispatch with the counters visible."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "spec_decode_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    verdict = lines[-1]
+    assert verdict["greedy_identical"] is True, lines
+    assert verdict["spec_tokens_per_verify"] > 1.3, lines
+    assert verdict["acceptance_rate"] > 0.5, lines
+    by_mode = {d["mode"]: d for d in lines[:-1]}
+    assert by_mode["speculative"]["steps"] < by_mode["baseline"]["steps"]
+    assert by_mode["speculative"]["spec_rolled_back"] == (
+        by_mode["speculative"]["spec_drafted"]
+        - by_mode["speculative"]["spec_accepted"]
+    )
