@@ -11,17 +11,56 @@ Prints the PRIMARY training line first, then a serving-throughput line
 (BASELINE config 5: continuous-batching decode):
     {"metric": "llama_flagship_train_mfu", "value": N, "unit": ...}
     {"metric": "llama_flagship_decode_tput", "value": N, "unit": ...}
+
+The training line carries `compile_s` (first-step wall time, dominated by
+the XLA compile) separately from `steady_step_s`, so a config whose compile
+eats the tunnel window is visible in `BENCH_*.json` instead of silently
+inflating the warmup.
+
+Probe mode (`--probe NAME|all`, `--list-probes`) A/Bs the scan-grouping /
+selective-remat knobs unattended: each probe runs `bench.py --train-only`
+in a SUBPROCESS under its own compile budget, so a pathological compile
+(PERF.md: `scan_unroll=2` burned >12 min untracked) becomes a recorded
+`compile_timeout` JSON line instead of eating the whole tunnel window.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
 BASELINE_MFU = 0.45  # north-star target, BASELINE.json:5
 
 WARMUP_STEPS = 3  # excluded from timing (includes XLA compile)
+
+# Probe presets: overrides + a per-probe compile budget (seconds). The
+# budget bounds the SUBPROCESS wall clock at budget + PROBE_STEADY_S (the
+# allowance for the post-compile steps), so a probe that compiles but
+# steps slowly still reports. Grouped-scan bodies grow with G — budgets
+# widen accordingly, against the known compile cliff (scan_unroll=2 was
+# >720s; the grouped body is compiled ONCE, not duplicated per unrolled
+# step, so these should land far under their budgets — the budget is the
+# tripwire that proves it).
+TRAIN_PROBES: dict[str, tuple[list, int]] = {
+    "baseline": ([], 600),
+    "scan_group2": (["model.scan_group=2"], 600),
+    "scan_group4": (["model.scan_group=4"], 720),
+    "remat_names": (["train.remat=names"], 600),
+    "remat_names_offload": (
+        ["train.remat=names", "train.remat_offload=true"], 600),
+    "scan_group2_names": (
+        ["model.scan_group=2", "train.remat=names"], 720),
+    "scan_group2_names_offload": (
+        ["model.scan_group=2", "train.remat=names",
+         "train.remat_offload=true"], 720),
+    "scan_group2_gradbf16": (
+        ["model.scan_group=2", "train.grad_dtype=bfloat16"], 720),
+    "gradbf16": (["train.grad_dtype=bfloat16"], 600),
+}
+PROBE_STEADY_S = 240   # post-compile step allowance per probe
+PROBE_STEPS = 12       # compile + a few steady-state steps
 
 # Serving bench shape: max_batch_size concurrent streams, short prompts.
 DECODE_BATCH = 32
@@ -46,7 +85,20 @@ def bench_train(overrides) -> int:
 
     cfg = get_config("llama-1b-bench", overrides)
     trainer = Trainer(cfg)
-    history = trainer.fit()
+    # One manual step before the loop: its wall time IS the XLA compile
+    # (plus one step), and the marker line is printed IMMEDIATELY — so a
+    # probe parent that later kills this subprocess can tell a compile
+    # overrun (no marker yet) from a slow-step overrun (marker present)
+    # in the captured stdout. fit() then continues from the stepped state;
+    # WARMUP_STEPS still pads the steady-state window.
+    state, start = trainer.restore_or_init()
+    t0 = time.perf_counter()
+    state, _ = trainer.train_step(state, trainer.global_batch(start))
+    jax.block_until_ready(state["step"])
+    compile_s = time.perf_counter() - t0
+    print(json.dumps({"metric": "llama_flagship_train_compile",
+                      "compile_s": round(compile_s, 1)}), flush=True)
+    history = trainer.fit(state)
 
     steady = history[WARMUP_STEPS:]
     if not steady:
@@ -54,6 +106,7 @@ def bench_train(overrides) -> int:
         return 1
     mean_tps = sum(m.tokens_per_sec_per_device for m in steady) / len(steady)
     mean_mfu = sum(m.mfu for m in steady) / len(steady)
+    mean_step = sum(m.step_time_s for m in steady) / len(steady)
     dev = jax.devices()[0]
 
     result = {
@@ -65,6 +118,12 @@ def bench_train(overrides) -> int:
         "device": dev.device_kind,
         "model": cfg.model.name,
         "steps_timed": len(steady),
+        # Measured first-step wall time, dominated by the XLA compile (the
+        # steady step is subtracted out); recorded per run so compile
+        # regressions (the scan_unroll=2 cliff, PERF.md) show up in
+        # BENCH_*.json.
+        "compile_s": round(max(compile_s - mean_step, 0.0), 1),
+        "steady_step_s": round(mean_step, 3),
         "final_loss": round(steady[-1].loss, 4),
     }
     print(json.dumps(result))
@@ -172,6 +231,148 @@ def bench_infer(overrides, metric="llama_flagship_decode_tput") -> int:
     return 0
 
 
+def _probe_json(out: dict) -> None:
+    print(json.dumps(out), flush=True)
+
+
+def run_train_probe(
+    name: str,
+    overrides: list,
+    budget_s: int,
+    extra: list,
+    cpu: bool = False,
+    steps: int = PROBE_STEPS,
+) -> dict:
+    """One A/B probe in a subprocess under a compile budget.
+
+    The subprocess is `bench.py --train-only` (or the tiny-llama train.py
+    logic check under --cpu, mirroring tools/scan_probe.py); wall clock is
+    bounded by budget_s + PROBE_STEADY_S. A timeout before the metric line
+    is recorded as `compile_timeout` — the round-3 failure mode ("compile
+    >12 min, never measured") becomes data instead of a burned window.
+    """
+    if cpu:
+        import pathlib
+
+        train_py = str(pathlib.Path(__file__).resolve().parent / "train.py")
+        args = [sys.executable, train_py, "--preset", "tiny-llama",
+                "runtime.platform=cpu", "model.n_layers=4",
+                "data.batch_size=4", "data.seq_len=64",
+                f"train.num_steps={steps}", "train.log_interval=1000",
+                "optimizer.warmup_steps=2"] + overrides + extra
+    else:
+        args = [sys.executable, __file__, "--train-only",
+                "--skip-device-probe", f"train.num_steps={steps}",
+                "train.log_interval=100000"] + overrides + extra
+    out = {"probe": name, "overrides": overrides, "budget_s": budget_s}
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            args, capture_output=True, text=True,
+            timeout=budget_s + PROBE_STEADY_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        out["wall_s"] = round(time.perf_counter() - t0, 1)
+        _merge_metric_line(out, stdout)
+        # Separate the two timeout causes: bench_train prints a compile
+        # marker line right after the first (compiling) step, so a killed
+        # probe whose stdout carries the marker (or the final metric line)
+        # compiled fine and overran on the steps — blame the steps, keep
+        # any measurement. Only a kill BEFORE the marker is a compile
+        # timeout. (The --cpu logic-check path has no marker; its
+        # timeouts all read as compile_timeout, which is fine for a
+        # tiny-shape smoke mode.)
+        if not cpu and (out.get("compile_s") or 0) > budget_s:
+            # Same rule as the finished-run branch below: a compile that
+            # overran its budget is a compile violation even if the kill
+            # then landed on the steps.
+            out["status"] = "compile_over_budget"
+        elif out.get("mfu_pct") is not None or out.get("compiled"):
+            out["status"] = "step_timeout"
+        else:
+            out["status"] = "compile_timeout"
+        return out
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    if r.returncode != 0:
+        out.update(status="error", tail=(r.stdout[-200:] + r.stderr[-200:]))
+        return out
+    out["status"] = "ok"
+    _merge_metric_line(out, r.stdout)
+    if not cpu and out.get("compile_s", 0) > budget_s:
+        # Finished, but the compile alone overran its budget: record the
+        # violation so an unattended A/B doesn't quietly promote a config
+        # that cannot be iterated on within a tunnel window.
+        out["status"] = "compile_over_budget"
+    return out
+
+
+def _merge_metric_line(out: dict, text: str) -> dict:
+    for line in (text or "").splitlines():
+        if not line.startswith("{"):
+            continue
+        if ("llama_flagship_train_compile" not in line
+                and "llama_flagship_train_mfu" not in line):
+            continue
+        try:
+            j = json.loads(line)
+        except json.JSONDecodeError:
+            # A subprocess killed mid-write leaves a truncated line; the
+            # probe still reports its status, just without that line.
+            continue
+        if j.get("metric") == "llama_flagship_train_compile":
+            out["compiled"] = True
+            out.setdefault("compile_s", j.get("compile_s"))
+            continue
+        for key in ("value", "tokens_per_sec_per_chip", "compile_s",
+                    "steady_step_s", "final_loss"):
+            if key in j:
+                out["mfu_pct" if key == "value" else key] = j[key]
+    return out
+
+
+def probe_winner(results: list) -> dict | None:
+    """The promotable winner among probe rows — only clean finishes
+    compete: a compile_over_budget (or timed-out-but-measured) probe is
+    recorded data, not a promotable winner. ONE rule, shared with
+    tools/scan_probe.py."""
+    ok = [r for r in results
+          if r.get("mfu_pct") is not None and r.get("status") == "ok"]
+    return max(ok, key=lambda r: r["mfu_pct"]) if ok else None
+
+
+def run_probes(selector: str, extra: list, cpu: bool = False,
+               steps: int = PROBE_STEPS,
+               budget_override: int = 0) -> int:
+    names = list(TRAIN_PROBES) if selector == "all" else [selector]
+    unknown = [n for n in names if n not in TRAIN_PROBES]
+    if unknown:
+        print(json.dumps({"error": f"unknown probe {unknown}; "
+                          f"have {sorted(TRAIN_PROBES)}"}))
+        return 2
+    results = []
+    for name in names:
+        overrides, budget = TRAIN_PROBES[name]
+        if budget_override:
+            # An explicit --budget wins outright (no --cpu clamp: the
+            # caller asked for exactly this much).
+            budget = budget_override
+        elif cpu:
+            budget = min(budget, 420)
+        res = run_train_probe(name, overrides, budget, extra, cpu=cpu,
+                              steps=steps)
+        results.append(res)
+        _probe_json(res)
+    best = probe_winner(results)
+    if best:
+        _probe_json({"summary": "bench_probe_winner",
+                     "probe": best["probe"], "mfu_pct": best["mfu_pct"],
+                     "compile_s": best.get("compile_s")})
+    return 0
+
+
 def _probe_device(timeout_s: float = 180.0) -> bool:
     """Check the accelerator actually answers before committing to a run.
 
@@ -198,9 +399,72 @@ def _probe_error(msg: str) -> None:
 
 def main() -> int:
     argv = sys.argv[1:]
+    if "--list-probes" in argv:
+        for name, (ov, budget) in TRAIN_PROBES.items():
+            print(json.dumps({"probe": name, "overrides": ov,
+                              "compile_budget_s": budget}))
+        return 0
     train_only = "--train-only" in argv   # probes (tools/scan_probe.py)
     argv = [a for a in argv if a != "--train-only"]
-    if not _probe_device():
+    # Private flag set by run_train_probe's subprocesses (the parent
+    # probed already); manual --train-only runs still get the 180 s
+    # liveness probe instead of hanging on a dead tunnel.
+    skip_probe = "--skip-device-probe" in argv
+    argv = [a for a in argv if a != "--skip-device-probe"]
+    probe_cpu = "--cpu" in argv
+    argv = [a for a in argv if a != "--cpu"]
+    def _flag_value(flag):
+        # Consistent failure surface: a malformed flag prints the same JSON
+        # error line every other failure mode in this file emits (the
+        # tunnel-window queue parses stdout as JSON lines).
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            print(json.dumps({"error": f"{flag} needs a value"}))
+            raise SystemExit(2)
+        value = argv[i + 1]
+        del argv[i:i + 2]
+        return value
+
+    has_steps, has_budget = "--steps" in argv, "--budget" in argv
+    try:
+        probe_steps = (
+            int(_flag_value("--steps")) if has_steps else PROBE_STEPS
+        )
+        budget_override = (
+            int(_flag_value("--budget")) if has_budget else 0
+        )
+    except ValueError as e:
+        print(json.dumps({"error": f"bad flag value: {e}"}))
+        return 2
+    if "--probe" in argv:
+        selector = _flag_value("--probe")
+        extra = list(argv)
+        if not probe_cpu and probe_steps <= WARMUP_STEPS + 1:
+            # The manual compile step consumes one num_steps and warmup
+            # pads the rest: fewer steps leaves an empty steady-state
+            # window, which would surface as a confusing subprocess error.
+            print(json.dumps({"error": f"--steps must be > "
+                              f"{WARMUP_STEPS + 1} (1 compile step + "
+                              f"{WARMUP_STEPS} warmup) to leave a "
+                              f"steady-state window"}))
+            return 2
+        if not probe_cpu and not _probe_device():
+            return 1
+        return run_probes(selector, extra, cpu=probe_cpu,
+                          steps=probe_steps, budget_override=budget_override)
+    if probe_cpu or has_steps or has_budget:
+        # Presence, not value: `--steps 12` (the default) without --probe
+        # must error too, not fall through to the real TPU bench.
+        # These flags only mean something in probe mode; silently falling
+        # through to the real TPU bench would burn the window the flag was
+        # trying to avoid.
+        print(json.dumps({"error": "--cpu/--steps/--budget require --probe"}))
+        return 2
+    if not skip_probe and not _probe_device():
+        # Probe subprocesses pass --skip-device-probe: the parent probed
+        # the device already, and a second 180 s probe here would count
+        # against the subprocess's compile budget — a slow tunnel would
+        # read as a compile timeout.
         return 1
     # Silence per-step logging so stdout is exactly the JSON lines; user
     # overrides can still re-enable it.

@@ -52,3 +52,21 @@ if not hasattr(_jax.lax, "pcast"):
         return x
 
     _jax.lax.pcast = _pcast
+
+# The `name` primitive (jax.ad_checkpoint.checkpoint_name — the
+# remat="names" annotation in models/transformer.py) has no shard_map
+# replication rule on this jax version, so a rep-checked shard_map region
+# (the pipeline loop) raises "No replication rule for name" for ANY model
+# whose block body carries annotations. checkpoint_name is an identity:
+# the standard check (output replication = input replication) and the
+# no-rewrite rule are exact. No-op where jax already registers them.
+try:
+    from jax._src.ad_checkpoint import name_p as _name_p
+    from jax.experimental import shard_map as _sm_mod
+
+    if _name_p not in _sm_mod._check_rules:
+        _sm_mod.register_standard_check(_name_p)
+    if _name_p not in _sm_mod._rewrite_rules:
+        _sm_mod.register_norewrite(_name_p)
+except (ImportError, AttributeError):
+    pass

@@ -128,8 +128,23 @@ class ModelConfig:
     pp_virtual_stages: int = 1
 
     # Gradient checkpointing policy for the layer scan:
-    # "none" | "full" | "dots" (checkpoint_dots_with_no_batch_dims).
+    #   "none"  - save everything (no recompute; largest memory)
+    #   "full"  - save nothing per block (1.33x executed FLOPs; smallest)
+    #   "dots"  - checkpoint_dots_with_no_batch_dims (saves every matmul
+    #             output, including the [B,S,F] MLP hiddens — OOMs where
+    #             "names" fits)
+    #   "names" - name-based selective remat: save exactly the activations
+    #             annotated with jax.ad_checkpoint.checkpoint_name in the
+    #             block body (flash-attention outputs, norm outputs, FFN/
+    #             MoE outputs — models/transformer.REMAT_SAVE_NAMES), a few
+    #             [B,S,D]-sized tensors per layer. The middle ground
+    #             between "full"'s recompute tax and "dots"'s footprint.
     remat: str = "none"
+    # With remat="names": park the saved named activations in host RAM
+    # (save_and_offload_only_these_names) instead of HBM. Frees the entire
+    # named-stash footprint from the device at the cost of PCIe/host
+    # transfers overlapping the step. Invalid with any other remat policy.
+    remat_offload: bool = False
 
     # Stream the LM-head projection + cross-entropy over sequence chunks of
     # this size (must divide seq_len) instead of materializing the full
@@ -146,11 +161,52 @@ class ModelConfig:
 
     # Layers are evaluated with lax.scan over stacked per-layer params.
     scan_layers: bool = True
-    # lax.scan unroll factor for the layer loop (must divide n_layers).
-    # The v5e profile puts ~19% of device time in the scan's carry/grad
-    # dynamic-update-slice fusions; unrolling amortizes them over several
-    # layers per scan iteration at a modest compile-time cost. 1 = off.
+    # lax.scan unroll factor for the layer loop (must divide the number of
+    # scan units). The v5e profile puts ~19% of device time in the scan's
+    # carry/grad dynamic-update-slice fusions; unrolling amortizes the loop
+    # bookkeeping at a compile-time cost — but the remat'd body is
+    # DUPLICATED per unrolled step (fwd+bwd), which blew past a 12-minute
+    # compile budget at unroll=2 on the bench chip (PERF.md). Prefer
+    # scan_group. 1 = off.
     scan_unroll: int = 1
+    # Grouped layer scan: scan over n_layers/scan_group GROUPS of
+    # scan_group statically-unrolled layers, with the remat boundary
+    # wrapping the GROUP. Unlike scan_unroll (which duplicates the remat'd
+    # body), the group is ONE remat'd body covering G layers, so the scan's
+    # stacked-buffer traffic — the fwd carry/named stash writes and the
+    # bwd per-layer grad dynamic-update-slices, 18.8% of the bench step
+    # (PERF.md) — drops by G× (L/G bigger slices instead of L small ones)
+    # while compile time stays bounded (the body grows G×; it is not
+    # duplicated into fwd and bwd copies per unrolled step). Must divide
+    # n_layers; with sliding_window_pattern the effective group is
+    # scan_group * pattern layers (windows stay static per group
+    # position). 1 = today's per-layer scan. Exactly grad-preserving.
+    scan_group: int = 1
+
+    def __post_init__(self):
+        # Domain checks only (each field alone): cross-field constraints
+        # (remat_offload needs remat="names", n_layers % scan_group, ...)
+        # live in the Trainer / forward pass — dotted CLI overrides apply
+        # one field at a time, so a cross-field check here would reject
+        # valid override sequences mid-application.
+        if self.remat is None:
+            # The override parser maps the literal string "none" to Python
+            # None for every field; for remat the canonical spelling is
+            # the string (presets compare against it) — normalize.
+            object.__setattr__(self, "remat", "none")
+        if self.remat not in ("none", "full", "dots", "names"):
+            raise ValueError(
+                f"model.remat={self.remat!r}; pick none|full|dots|names"
+            )
+        # `is None` first: the override parser maps the literal "none" to
+        # None for every field, and None < 1 is a TypeError, not the
+        # domain-check message.
+        if self.scan_group is None or self.scan_group < 1:
+            raise ValueError(f"model.scan_group={self.scan_group} must be >= 1")
+        if self.scan_unroll is None or self.scan_unroll < 1:
+            raise ValueError(
+                f"model.scan_unroll={self.scan_unroll} must be >= 1"
+            )
 
     @property
     def resolved_head_dim(self) -> int:
@@ -182,6 +238,14 @@ class ModelConfig:
             self.sliding_window_pattern
             if self.sliding_window is not None else None
         )
+
+    @property
+    def scan_unit(self) -> int:
+        """Layers per layer-scan iteration (and per remat body): scan_group
+        multiples of the window-pattern unit. Windows stay static per
+        within-group position because the unit is a multiple of the
+        pattern. Must divide n_layers (checked where the scan is built)."""
+        return self.scan_group * (self.window_pattern or 1)
 
     def layer_window(self, layer: int) -> Optional[int]:
         """The sliding window for a given layer index (None = global).
@@ -360,6 +424,19 @@ class TrainConfig:
     # itself is rounded (standard mixed-precision practice). Measure per
     # model: the trajectory tracks f32 closely but not bitwise.
     grad_dtype: Optional[str] = None
+    # Training-side override of the remat policy ("inherit" = use
+    # model.remat as-is). `train.remat=names` is the canonical spelling for
+    # selective remat at train time: the Trainer folds it into the model
+    # config, so checkpoints/serving configs keep their own model.remat.
+    # Values as model.remat: "none" | "full" | "dots" | "names". (The
+    # sentinel is "inherit", not None: the CLI override parser maps the
+    # literal "none" to None, which must mean remat OFF, not unset.)
+    remat: Optional[str] = "inherit"
+    # With an effective remat policy of "names": offload the saved named
+    # activations to host RAM instead of HBM (model.remat_offload). The
+    # middle ground the 16 GB bench chip cannot otherwise express: "full"
+    # pays 1.33x executed FLOPs, "dots" OOMs (PERF.md).
+    remat_offload: bool = False
     # Profiling window (jax.profiler trace), e.g. (10, 20). None disables.
     profile_steps: Optional[Tuple[int, int]] = None
     profile_dir: str = "/tmp/orion_tpu_profile"

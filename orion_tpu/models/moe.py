@@ -35,6 +35,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 try:  # jax >= 0.5: explicit mesh axis types (Manual detection under pp)
@@ -74,6 +75,10 @@ def _router_topk(
     onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)   # [B,S,k,E]
     gate = (probs[..., None, :] * onehot).sum(-1)        # scatter-free gather
     gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)  # renormalize
+    # remat="names" (models/transformer.REMAT_SAVE_NAMES) saves the gates:
+    # [B,S,k] f32 is near-free to store and pins the softmax/argsort chain
+    # every dispatch mode's backward needs. No-op under other policies.
+    gate = checkpoint_name(gate, "moe_router_gate")
     return probs, gate, idx
 
 

@@ -17,6 +17,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from orion_tpu import ops
 from orion_tpu.config import ModelConfig
@@ -24,6 +25,48 @@ from orion_tpu.models import moe as moe_lib
 from orion_tpu.models.quantize import load_weight as _load_w
 
 Params = dict[str, Any]
+
+# The activations saved under remat="names" (checkpoint_name annotations in
+# the block body below + models/moe.py): expensive to recompute relative to
+# their [B,S,·]-sized storage. Everything else (QKV projections, the
+# [B,S,F] MLP hiddens that make remat="dots" OOM, softmax internals)
+# rematerializes in the backward.
+REMAT_SAVE_NAMES = (
+    "attn_out",        # flash-attention kernel output [B,S,N,H]
+    "attn_norm_out",   # pre-attention norm output     [B,S,D]
+    "mlp_norm_out",    # pre-FFN norm output           [B,S,D]
+    "ffn_out",         # MLP / MoE-combine output      [B,S,D]
+    "moe_router_gate",  # renormalized top-k gates     [B,S,k] (models/moe.py)
+)
+
+
+def remat_policy(cfg: ModelConfig):
+    """The jax.checkpoint policy for ``cfg.remat`` (None = no remat).
+
+    "names" saves exactly REMAT_SAVE_NAMES; with ``cfg.remat_offload`` the
+    saved tensors are parked in host RAM (pinned_host) instead of HBM —
+    the save set is identical, only its residence changes, so grads are
+    bitwise equal across the three of none/names/names+offload.
+    """
+    if cfg.remat_offload and cfg.remat != "names":
+        raise ValueError(
+            f"model.remat_offload requires model.remat='names' "
+            f"(got remat={cfg.remat!r}): the offload set IS the named set"
+        )
+    if cfg.remat == "names":
+        if cfg.remat_offload:
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=list(REMAT_SAVE_NAMES),
+                offload_src="device",
+                offload_dst="pinned_host",
+            )
+        return jax.checkpoint_policies.save_only_these_names(
+            *REMAT_SAVE_NAMES
+        )
+    if cfg.remat == "dots":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    return None
 
 # ---------------------------------------------------------------------------
 # Initialization (+ the logical-axis tree used by parallel.sharding)
@@ -362,6 +405,10 @@ def _attn_block(
             block_kv=cfg.attn_block_kv,
             impl=cfg.kernels,
         )
+    # remat="names" saves the kernel output: the single most expensive
+    # per-layer tensor to rebuild (a full flash fwd pass) at [B,S,N,H]
+    # storage. (No-op identity under every other policy.)
+    out = checkpoint_name(out, "attn_out")
     return out_proj(out, p, cfg)
 
 
@@ -401,14 +448,16 @@ def _block(
     per block without guessing from fused-op names).
     """
     with jax.named_scope("attention"):
-        a = _attn_block(_norm(x, bp["attn_norm"], cfg), bp["attn"], cfg,
+        xn = checkpoint_name(_norm(x, bp["attn_norm"], cfg), "attn_norm_out")
+        a = _attn_block(xn, bp["attn"], cfg,
                         positions, segment_ids, mesh, window)
         if cfg.post_norms:
             a = _norm(a, bp["post_attn_norm"], cfg)
         x = x + a
     with jax.named_scope("mlp_moe"):
-        h = _norm(x, bp["mlp_norm"], cfg)
+        h = checkpoint_name(_norm(x, bp["mlp_norm"], cfg), "mlp_norm_out")
         y, aux = mlp_or_moe(h, bp, cfg, mesh)
+        y = checkpoint_name(y, "ffn_out")
         if cfg.post_norms:
             y = _norm(y, bp["post_mlp_norm"], cfg)
     return x + y, aux
@@ -461,11 +510,28 @@ def _hidden_states(
     with jax.named_scope("embed"):
         x = embed(params, tokens, positions, cfg)
 
+    def _remat(fn):
+        """Wrap a scan/pipeline body in the configured remat policy. The
+        boundary is the BODY — for grouped scans that is the whole group,
+        so the fwd residual stash and the bwd stacked-grad writes happen
+        once per group instead of once per layer (the scan-stash share of
+        the profile, PERF.md)."""
+        # Built unconditionally: remat_policy owns the offload-requires-
+        # names check, which must fire for forward-only callers too (a
+        # silently ignored remat_offload would measure the wrong config).
+        policy = remat_policy(cfg)
+        if cfg.remat == "none":
+            return fn
+        # policy=None (remat="full") is jax.checkpoint's save-nothing
+        # default; the policy dispatch lives in remat_policy.
+        return jax.checkpoint(fn, policy=policy)
+
     def make_block_fn(window: Optional[int], with_rs: bool = False):
-        """Per-layer body. ``with_rs`` (the packed-pipeline path) takes the
-        per-row state (positions/segment_ids, already microbatch-sliced by
-        the pipeline) as a third argument instead of closing over the
-        full-batch arrays."""
+        """Per-layer body (NOT remat-wrapped: the caller wraps its scan/
+        pipeline unit via ``_remat``). ``with_rs`` (the packed-pipeline
+        path) takes the per-row state (positions/segment_ids, already
+        microbatch-sliced by the pipeline) as a third argument instead of
+        closing over the full-batch arrays."""
         if with_rs:
             def block_fn(carry, bp, rs):
                 return _block(
@@ -481,31 +547,42 @@ def _hidden_states(
                     )
                 return _block(carry, bp, cfg, pos, segment_ids, mesh, window)
 
-        if cfg.remat == "full":
-            return jax.checkpoint(block_fn)
-        if cfg.remat == "dots":
-            return jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.
-                checkpoint_dots_with_no_batch_dims,
-            )
         return block_fn
 
-    def pattern_groups(pattern: int, with_rs: bool = False):
-        """(grouped_blocks, group_fn) for interleaved local/global models:
-        the window is static per pattern position, so a GROUP of `pattern`
-        layers is the homogeneous unit both the layer scan and the
-        pipeline iterate (shared so the two paths cannot diverge)."""
+    def layer_groups(unit: int, with_rs: bool = False):
+        """(grouped_blocks, group_fn) for a scan/pipeline over GROUPS of
+        ``unit`` statically-unrolled layers. Two callers, one unit rule:
+
+        - window-pattern (Gemma-family) models: the window is static per
+          pattern position, so the unit is a multiple of the pattern and
+          layer j of a group resolves ``cfg.layer_window(j)`` (correct for
+          any group because unit % pattern == 0) — shared with the
+          pipeline so the two paths cannot diverge;
+        - ``cfg.scan_group``: groups of G homogeneous layers whose single
+          remat body cuts the stacked-buffer DUS writes by G.
+        """
         L = cfg.n_layers
-        if L % pattern:
+        if L % unit:
             raise ValueError(
-                f"n_layers={L} must be divisible by "
-                f"sliding_window_pattern={pattern}"
+                f"n_layers={L} must be divisible by the layer-scan unit "
+                f"{unit} (scan_group={cfg.scan_group}"
+                + (f" x sliding_window_pattern={cfg.window_pattern}"
+                   if cfg.window_pattern else "")
+                + ")"
             )
         fns = [make_block_fn(cfg.layer_window(j), with_rs)
-               for j in range(pattern)]
+               for j in range(unit)]
+        if cfg.scan_group == 1:
+            # Default scan_group: the remat boundary stays PER LAYER (the
+            # seed's behavior for window-pattern models). A group-wide
+            # boundary trades backward recompute working set — up to
+            # unit× the interior activations live at once — for the G×
+            # stash win; that trade is what scan_group>1 opts into, and
+            # must not silently hit memory-edge pattern configs that
+            # never set the knob.
+            fns = [_remat(f) for f in fns]
         grouped = jax.tree.map(
-            lambda a: a.reshape(L // pattern, pattern, *a.shape[1:]),
+            lambda a: a.reshape(L // unit, unit, *a.shape[1:]),
             params["blocks"],
         )
 
@@ -521,7 +598,8 @@ def _hidden_states(
                 aux_t = aux_t + aux
             return carry, aux_t
 
-        return grouped, group_fn
+        return grouped, (group_fn if cfg.scan_group == 1
+                         else _remat(group_fn))
 
     pattern = cfg.window_pattern
     pp_active = (
@@ -532,6 +610,13 @@ def _hidden_states(
     if pp_active:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires scan_layers=True")
+        if cfg.scan_group > 1:
+            raise ValueError(
+                "model.scan_group > 1 does not apply under pipeline "
+                "parallelism: the stage loop already iterates "
+                "pattern-group units and stage boundaries must stay "
+                "per-unit for the pp split (set scan_group=1)"
+            )
         from orion_tpu.parallel.pipeline import pipeline_forward
 
         # Packed sequences / custom positions are PER-ROW state: the
@@ -547,12 +632,12 @@ def _hidden_states(
 
         if pattern is None:
             pp_blocks = params["blocks"]
-            pp_fn = make_block_fn(cfg.sliding_window, with_rs)
+            pp_fn = _remat(make_block_fn(cfg.sliding_window, with_rs))
         else:
             # Window-pattern (Gemma-family) models pipeline over pattern
             # GROUPS — the grouped-scan unit, lifted into the stage body
             # (the trainer validates the unit count splits over pp*V).
-            pp_blocks, pp_fn = pattern_groups(pattern, with_rs)
+            pp_blocks, pp_fn = layer_groups(pattern, with_rs)
 
         x, moe_aux = pipeline_forward(
             x,
@@ -566,24 +651,32 @@ def _hidden_states(
             row_state=row_state,
         )
     elif cfg.scan_layers:
-        if pattern is None:
+        # The scan unit (= the remat body) is scan_group homogeneous
+        # layers, times the window pattern for interleaved local/global
+        # (Gemma-family) models. unit == 1 is today's per-layer scan.
+        unit = cfg.scan_unit
+        if unit == 1:
             x, aux = jax.lax.scan(
-                make_block_fn(cfg.layer_window(0)), x, params["blocks"],
-                unroll=cfg.scan_unroll,
+                _remat(make_block_fn(cfg.layer_window(0))),
+                x, params["blocks"], unroll=cfg.scan_unroll,
             )
-            moe_aux = aux.sum()
         else:
-            # Interleaved local/global layers (Gemma-family): scan over
-            # pattern GROUPS (shared unit with the pipeline branch).
-            grouped, group_fn = pattern_groups(pattern)
+            grouped, group_fn = layer_groups(unit)
             x, aux = jax.lax.scan(
                 group_fn, x, grouped, unroll=cfg.scan_unroll
             )
-            moe_aux = aux.sum()
+        moe_aux = aux.sum()
     else:
+        if cfg.scan_group > 1:
+            # Mirror the pp branch: a silently ignored knob would let a
+            # probe config measure nothing.
+            raise ValueError(
+                "model.scan_group > 1 requires model.scan_layers=true "
+                "(grouping is a property of the layer scan)"
+            )
         moe_aux = jnp.zeros((), jnp.float32)
         for l, bp in enumerate(params["blocks"]):
-            x, aux = make_block_fn(cfg.layer_window(l))(x, bp)
+            x, aux = _remat(make_block_fn(cfg.layer_window(l)))(x, bp)
             moe_aux = moe_aux + aux
     return x, moe_aux
 

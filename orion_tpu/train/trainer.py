@@ -260,6 +260,55 @@ class Trainer:
                 "model.weight_quant is a serving-only knob (the engine "
                 "quantizes at init); training runs full-precision masters"
             )
+        if cfg.train.remat != "inherit" or cfg.train.remat_offload:
+            # train.remat / train.remat_offload are the training-side
+            # spelling of the remat policy: fold them into the model config
+            # (the source of truth the forward pass reads), so checkpoints
+            # and serving configs keep their own model.remat. An explicit
+            # train.remat=none arrives as None (the override parser's
+            # spelling) and ModelConfig.__post_init__ normalizes it — it
+            # must DISABLE remat, not fall back to model.remat. An explicit
+            # train.remat to a NON-names policy takes the offload decision
+            # wholesale (model.remat_offload is dropped, not OR'd in), so
+            # overriding an offload-configured checkpoint to dots/full does
+            # not dead-end on the offload-requires-names check — but an
+            # explicit train.remat=names keeps a configured
+            # model.remat_offload (OR), so restating the canonical spelling
+            # cannot silently move the stash back into HBM.
+            explicit = cfg.train.remat != "inherit"
+            drop_model_offload = explicit and cfg.train.remat != "names"
+            cfg = _dc.replace(
+                cfg,
+                model=_dc.replace(
+                    cfg.model,
+                    remat=(cfg.train.remat if explicit
+                           else cfg.model.remat),
+                    remat_offload=(
+                        cfg.train.remat_offload if drop_model_offload
+                        else (cfg.train.remat_offload
+                              or cfg.model.remat_offload)
+                    ),
+                ),
+            )
+        # Validate the remat-policy coupling (offload requires "names") and
+        # the scan-unit split NOW, with config vocabulary — not as a trace-
+        # time error out of the middle of the forward pass.
+        from orion_tpu.models.transformer import remat_policy
+
+        remat_policy(cfg.model)
+        if cfg.model.scan_layers and cfg.model.n_layers % cfg.model.scan_unit:
+            raise ValueError(
+                f"model.n_layers={cfg.model.n_layers} must be divisible by "
+                f"the layer-scan unit {cfg.model.scan_unit} "
+                f"(model.scan_group={cfg.model.scan_group}"
+                + (f" x pattern={cfg.model.window_pattern}"
+                   if cfg.model.window_pattern else "") + ")"
+            )
+        if cfg.model.scan_group > 1 and not cfg.model.scan_layers:
+            raise ValueError(
+                "model.scan_group > 1 requires model.scan_layers=true "
+                "(grouping is a property of the layer scan)"
+            )
         if (
             cfg.parallel.pp_virtual_stages != 1
             and cfg.parallel.pp_schedule != "interleaved"
@@ -272,6 +321,12 @@ class Trainer:
         if cfg.parallel.pp > 1:
             # Route the layer stack through the GPipe pipeline over pp
             # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
+            if cfg.model.scan_group > 1:
+                raise ValueError(
+                    "model.scan_group > 1 is a layer-scan knob; under "
+                    "parallel.pp the stage loop already iterates "
+                    "pattern-group units (set scan_group=1)"
+                )
             pp, M = cfg.parallel.pp, cfg.parallel.pp_microbatches
             micro = cfg.data.batch_size // max(cfg.train.grad_accum, 1)
             # Window-pattern (Gemma-family) models pipeline over GROUPS of
@@ -422,9 +477,11 @@ class Trainer:
                 _checkify.check_error(err)
                 return out
 
+            self._jit_step = checked
             self.train_step = _checked_step
         else:
-            self.train_step = jax.jit(base_step, donate_argnums=(0,))
+            self._jit_step = jax.jit(base_step, donate_argnums=(0,))
+            self.train_step = self._jit_step
         if cfg.model.debug_asserts:
             # Manual-region sanitizer (runtime/asserts.py): device_assert
             # callbacks RECORD failures (raising inside an async callback
@@ -495,6 +552,73 @@ class Trainer:
     def abstract_state(self) -> TrainState:
         return abstract_train_state(self.cfg, shardings=self.shardings)
 
+    def memory_report(self, assert_donation: bool = True) -> dict:
+        """AOT-compile the jitted train step and report XLA's compiled
+        memory analysis — the ground truth for "does this remat policy fit"
+        (temp bytes = activations + workspace) and for whether the donated
+        master-param/optimizer-state buffers were actually reused.
+
+        With ``assert_donation`` (default), raise if any donated state
+        bytes failed to alias into the outputs: an un-aliased master/
+        moment buffer silently DOUBLES its footprint for the step, which
+        is exactly the headroom that decides whether remat=names fits at
+        bench batch 8 (PERF.md). (Not called from the hot path: the AOT
+        executable is separate from jit's own cache, so this costs one
+        extra compile.)
+        """
+        import math
+
+        state = self.abstract_state()
+        # Specs from the REAL assembled global batch (one materialization,
+        # trivial next to the AOT compile): on multi-process runs the
+        # host-local batch is only this process's shard, and lowering with
+        # its shape would analyze a program the hot path never runs.
+        batch = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding),
+            self.global_batch(0),
+        )
+        compiled = self._jit_step.lower(state, batch).compile()
+        ma = compiled.memory_analysis()
+
+        def _nbytes(leaf):
+            return math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
+
+        donated = sum(_nbytes(leaf) for leaf in jax.tree.leaves(state))
+        report = {
+            "donated_state_bytes": donated,
+            "available": ma is not None,
+        }
+        if self.mesh.size > 1:
+            # memory_analysis sizes are per-executable (per-device shard);
+            # the global state-byte comparison below only lines up on a
+            # single device. Report the numbers, skip the assertion.
+            assert_donation = False
+            report["note"] = (
+                "sharded state: analysis bytes are per-device; donation "
+                "assertion runs on single-device layouts only"
+            )
+        if ma is not None:
+            report.update(
+                argument_bytes=int(ma.argument_size_in_bytes),
+                output_bytes=int(ma.output_size_in_bytes),
+                temp_bytes=int(ma.temp_size_in_bytes),
+                alias_bytes=int(ma.alias_size_in_bytes),
+                unaliased_donated_bytes=max(
+                    0, donated - int(ma.alias_size_in_bytes)
+                ),
+            )
+            if assert_donation and report["unaliased_donated_bytes"] > 0:
+                raise RuntimeError(
+                    f"train-step donation leaked a copy: "
+                    f"{report['unaliased_donated_bytes']} of {donated} "
+                    f"donated state bytes were not aliased into the "
+                    f"outputs (alias_size={report['alias_bytes']}); check "
+                    f"for dtype/sharding mismatches between old and new "
+                    f"state leaves"
+                )
+        return report
+
     def restore_or_init(self) -> tuple[TrainState, int]:
         if self.ckpt is not None and self.cfg.checkpoint.restore:
             restored = self.ckpt.restore_latest(self.abstract_state())
@@ -505,7 +629,11 @@ class Trainer:
 
     # -- data -------------------------------------------------------------
 
-    def global_batch(self, step: int) -> Any:
+    def _host_batch(self, step: int) -> dict:
+        """The host-side batch exactly as the train step receives it
+        (grad_accum microbatch axis applied). Shared by the hot path and
+        memory_report, so the AOT-analyzed shapes cannot drift from the
+        shapes the real step runs."""
         host = dict(self.loader.batch_at(step))
         accum = self.cfg.train.grad_accum
         if accum > 1:
@@ -513,11 +641,14 @@ class Trainer:
                 k: v.reshape(accum, v.shape[0] // accum, *v.shape[1:])
                 for k, v in host.items()
             }
+        return host
+
+    def global_batch(self, step: int) -> Any:
         return jax.tree.map(
             lambda v: jax.make_array_from_process_local_data(
                 self.batch_shard, v
             ),
-            host,
+            self._host_batch(step),
         )
 
     def evaluate(self, params: Any) -> float:

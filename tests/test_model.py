@@ -147,6 +147,26 @@ def test_scan_unroll_matches_rolled():
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
 
 
+def test_scan_group_composes_with_unroll():
+    """model.scan_group (groups of statically-unrolled layers) matches the
+    per-layer scan and composes with scan_unroll (which then unrolls GROUP
+    steps). tests/test_scan_remat.py owns the grad-equivalence + HLO
+    suite; the unscanned stack is covered by test_scan_vs_unrolled_layers
+    (scan_group>1 with scan_layers=false is rejected by the Trainer)."""
+    cfg = get_config("tiny-llama", ["model.n_layers=4"]).model
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    ref, _ = forward(params, tokens, cfg)
+    for ov in (["model.scan_group=2"],
+               ["model.scan_group=2", "model.scan_unroll=2"],
+               ["model.scan_group=4"]):
+        cfg_g = get_config("tiny-llama", ["model.n_layers=4"] + ov).model
+        got, _ = forward(params, tokens, cfg_g)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, err_msg=str(ov)
+        )
+
+
 def test_remat_matches_no_remat():
     cfg = get_config("tiny-llama").model
     cfg_r = get_config("tiny-llama", ["model.remat=full"]).model

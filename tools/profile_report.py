@@ -5,10 +5,22 @@ Companion to ``train.profile_steps``: point it at the profile directory and
 it prints the leaf TPU-op groups by share of device time — the same
 analysis behind PERF.md's table. No TPU needed; parses the trace offline.
 
+Each group is also CLASSIFIED into a coarse bucket (scan-stash / attention
+/ matmul / fusion / data-movement), so the "18.8% scan bookkeeping" number
+stays attributable after the grouped layer scan renames the fusions (the
+grouped body's dynamic-update-slice fusions pick up .remat/.clone/unroll
+suffixes and fuse with neighbors, but the op kind survives in the name).
+
 Usage:
     python train.py --preset llama-1b-bench 'train.profile_steps=(5,7)' \
         train.profile_dir=/tmp/prof
     python tools/profile_report.py /tmp/prof
+    python tools/profile_report.py --compare /tmp/prof_base /tmp/prof_g2
+
+``--compare A B`` diffs the group (and bucket) shares between two profile
+dirs — the A/B view for `model.scan_group` / `train.remat=names` probes:
+run the same profile window under both configs and the stash share delta
+is the first table printed.
 """
 
 from __future__ import annotations
@@ -34,6 +46,46 @@ def find_trace(root: str) -> str:
 # Container events (enclose leaf ops; counting them double-counts time).
 _SKIP = re.compile(r"^(jit_|while|\d+$|body|condition|region|cond)")
 
+# Numbering / rematerialization / cloning suffix fragments. The grouped
+# layer scan's single remat body makes XLA emit names like
+# ``fusion.123.remat2.clone.1`` (suffixes CHAIN, in any order) — strip the
+# whole chain so a rematted clone aggregates with its base group instead of
+# fragmenting the report.
+_SUFFIX = re.compile(r"(\.(\d+|remat\d*|clone|unrolled(_\d+)?))+$")
+
+
+def group_name(name: str) -> str:
+    return _SUFFIX.sub("", name)
+
+
+# Coarse buckets, tested on the op-kind substrings XLA keeps in fusion
+# names across regroupings. Order matters: attention kernels go first (a
+# paged/flash KV-write fusion in a serving trace can also contain
+# "dynamic-update-slice" — it is attention work, not scan stash; training
+# stash DUS fusions never carry the kernel names), then scan-stash ahead
+# of data-movement because its fusions often also contain "bitcast".
+_BUCKETS = (
+    ("attention-kernel", ("attention", "flash", "paged")),
+    ("scan-stash", ("dynamic-update-slice", "dynamic_update_slice")),
+    ("collective", ("all-reduce", "all-gather", "all-to-all",
+                    "collective", "reduce-scatter", "permute")),
+    ("matmul", ("convolution", "dot")),
+    ("data-movement", ("copy", "convert", "bitcast", "transpose",
+                       "dynamic-slice", "dynamic_slice", "broadcast",
+                       "slice")),
+    ("reduce", ("reduce",)),
+)
+
+
+def classify(group: str) -> str:
+    """Map a leaf group name to its coarse bucket ("other" if unknown)."""
+    for bucket, needles in _BUCKETS:
+        if any(n in group for n in needles):
+            return bucket
+    if group.startswith("fusion"):
+        return "fusion(matmul+elementwise)"
+    return "other"
+
 
 def leaf_groups(trace_path: str) -> tuple[dict[str, float], float]:
     with gzip.open(trace_path) as f:
@@ -53,23 +105,66 @@ def leaf_groups(trace_path: str) -> tuple[dict[str, float], float]:
         name = e.get("name", "?")
         if _SKIP.match(name):
             continue
-        group = re.sub(r"\.\d+(\.remat\d*)?(\.clone)?$", "", name)
-        dur[group] += e["dur"]
+        dur[group_name(name)] += e["dur"]
     return dict(dur), sum(dur.values())
 
 
-def main(argv: list[str]) -> int:
-    if len(argv) != 2:
-        print(__doc__)
-        return 2
-    trace = find_trace(argv[1])
+def bucket_shares(groups: dict[str, float]) -> dict[str, float]:
+    total = sum(groups.values()) or 1.0
+    buckets: collections.Counter = collections.Counter()
+    for name, d in groups.items():
+        buckets[classify(name)] += d
+    return {b: d / total for b, d in buckets.items()}
+
+
+def report(root: str) -> int:
+    trace = find_trace(root)
     groups, total = leaf_groups(trace)
     print(f"trace: {trace}")
     print(f"leaf device time: {total / 1e3:.1f} ms\n")
-    print(f"{'ms':>10}  {'share':>6}  group")
+    print(f"{'share':>6}  bucket")
+    for b, s in sorted(bucket_shares(groups).items(), key=lambda kv: -kv[1]):
+        print(f"{100 * s:5.1f}%  {b}")
+    print(f"\n{'ms':>10}  {'share':>6}  {'bucket':<24}  group")
     for name, d in sorted(groups.items(), key=lambda kv: -kv[1])[:25]:
-        print(f"{d / 1e3:10.2f}  {100 * d / total:5.1f}%  {name[:70]}")
+        print(f"{d / 1e3:10.2f}  {100 * d / total:5.1f}%  "
+              f"{classify(name):<24}  {name[:50]}")
     return 0
+
+
+def compare(root_a: str, root_b: str) -> int:
+    """Diff group/bucket shares between two profile dirs (B minus A)."""
+    ga, ta = leaf_groups(find_trace(root_a))
+    gb, tb = leaf_groups(find_trace(root_b))
+    sa = {k: v / (ta or 1.0) for k, v in ga.items()}
+    sb = {k: v / (tb or 1.0) for k, v in gb.items()}
+    print(f"A: {root_a}  ({ta / 1e3:.1f} ms leaf device time)")
+    print(f"B: {root_b}  ({tb / 1e3:.1f} ms leaf device time)")
+    print(f"total leaf time: {tb / max(ta, 1e-9):.3f}x of A\n")
+    print(f"{'A':>7}  {'B':>7}  {'delta':>7}  bucket")
+    ba, bb = bucket_shares(ga), bucket_shares(gb)
+    for b in sorted(set(ba) | set(bb),
+                    key=lambda b: -abs(bb.get(b, 0.0) - ba.get(b, 0.0))):
+        da, db = ba.get(b, 0.0), bb.get(b, 0.0)
+        print(f"{100 * da:6.1f}%  {100 * db:6.1f}%  {100 * (db - da):+6.1f}%"
+              f"  {b}")
+    print(f"\n{'A':>7}  {'B':>7}  {'delta':>7}  group")
+    names = sorted(set(sa) | set(sb),
+                   key=lambda n: -abs(sb.get(n, 0.0) - sa.get(n, 0.0)))
+    for name in names[:25]:
+        da, db = sa.get(name, 0.0), sb.get(name, 0.0)
+        print(f"{100 * da:6.1f}%  {100 * db:6.1f}%  {100 * (db - da):+6.1f}%"
+              f"  {name[:55]}")
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) == 4 and argv[1] == "--compare":
+        return compare(argv[2], argv[3])
+    if len(argv) != 2 or argv[1].startswith("--"):
+        print(__doc__)
+        return 2
+    return report(argv[1])
 
 
 if __name__ == "__main__":

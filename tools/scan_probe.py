@@ -3,70 +3,42 @@
 
 VERDICT r3 item 1 / weak #4: ~19 % of bench step time is scan bookkeeping
 (remat carry stash + stacked per-layer grad writes), and the two knobs that
-attack it (`model.scan_unroll`, full unroll) previously timed out compiling
-through the tunneled chip with no record. This probe runs each candidate in
+attack it (`model.scan_group`, `model.scan_unroll`) previously timed out
+compiling through the tunneled chip with no record. Each candidate runs in
 a SUBPROCESS with a wall-clock budget, so a pathological compile becomes a
-recorded TIMEOUT line instead of a hung session:
+recorded timeout line instead of a hung session:
 
     python tools/scan_probe.py                 # on-chip, 15 min/candidate
     python tools/scan_probe.py --budget 300    # custom budget (seconds)
     python tools/scan_probe.py --cpu           # tiny-shape logic check
 
-Candidates: scan_unroll x {1, 2, 4}, train.grad_dtype=bfloat16, and the
-combination. Output: one JSON line per candidate (MFU + step time, or the
-timeout/error), then a summary naming the winner.
+The runner is bench.run_train_probe — ONE subprocess/budget/parse
+implementation (`bench.py --probe all` runs the full scan_group x
+remat=names grid; this tool keeps the historical scan-stash candidate
+list, including the known-cliff unroll2 control, on the same machinery;
+the subprocess gets budget + bench.PROBE_STEADY_S of wall clock, the
+budget bounding the compile).
 """
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
-import subprocess
 import sys
 
-PROBE_STEPS = 12  # enough for compile + a few steady-state steps
+import bench
 
-
-def _parse_stdout(out, text):
-    for line in (text or "").splitlines():
-        if line.startswith("{") and "llama_flagship_train_mfu" in line:
-            j = json.loads(line)
-            out["mfu_pct"] = j.get("value")
-            out["tok_s_chip"] = j.get("tokens_per_sec_per_chip")
-        if line.startswith("done:"):
-            out["final_line"] = line.strip()
-    return out
-
-
-def run_candidate(name, overrides, budget_s, cpu):
-    # --train-only: the probe budget is for the TRAIN compile+steps; the
-    # serving benches are irrelevant here and must not consume it.
-    args = [sys.executable, "bench.py", "--train-only",
-            "train.log_interval=1000",
-            f"train.num_steps={PROBE_STEPS}"] + overrides
-    if cpu:
-        # The bench probes the accelerator; force the CPU path via the
-        # preset overrides instead (tiny shapes, logic check only).
-        args = [sys.executable, "train.py", "--preset", "tiny-llama",
-                "runtime.platform=cpu", "data.batch_size=4",
-                "data.seq_len=64", f"train.num_steps={PROBE_STEPS}",
-                "train.log_interval=1000", "optimizer.warmup_steps=2",
-                ] + overrides
-    try:
-        r = subprocess.run(args, capture_output=True, text=True,
-                           timeout=budget_s)
-    except subprocess.TimeoutExpired as e:
-        # Keep any already-captured result line: a candidate that measured
-        # its MFU and then hung is a RESULT with a caveat, not a loss.
-        stdout = e.stdout
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
-        return _parse_stdout(
-            {"candidate": name, "status": "TIMEOUT", "budget_s": budget_s},
-            stdout,
-        )
-    if r.returncode != 0:
-        return {"candidate": name, "status": "ERROR",
-                "tail": r.stdout[-200:] + r.stderr[-200:]}
-    return _parse_stdout({"candidate": name, "status": "OK"}, r.stdout)
+# scan_group moves the remat boundary around a group of G layers (ONE
+# body, G x fewer stash DUS writes) where scan_unroll DUPLICATES the
+# remat'd body per unrolled step — the measured >12-min compile cliff.
+# unroll2 stays as the known-cliff control.
+CANDIDATES = [
+    ("baseline", []),
+    ("scan_group2", ["model.scan_group=2"]),
+    ("scan_group4", ["model.scan_group=4"]),
+    ("unroll2", ["model.scan_unroll=2"]),
+    ("gradbf16", ["train.grad_dtype=bfloat16"]),
+    ("scan_group2+gradbf16",
+     ["model.scan_group=2", "train.grad_dtype=bfloat16"]),
+]
 
 
 def main() -> int:
@@ -78,25 +50,21 @@ def main() -> int:
     if cpu:
         budget = min(budget, 420)
 
-    candidates = [
-        ("baseline", []),
-        ("unroll2", ["model.scan_unroll=2"]),
-        ("unroll4", ["model.scan_unroll=4"]),
-        ("gradbf16", ["train.grad_dtype=bfloat16"]),
-        ("unroll2+gradbf16",
-         ["model.scan_unroll=2", "train.grad_dtype=bfloat16"]),
-    ]
+    # Probe the device ONCE here: the --train-only subprocesses skip
+    # their own probe so the budget measures only compile + steps.
+    if not cpu and not bench._probe_device():
+        return 1
+
     results = []
-    for name, ov in candidates:
-        res = run_candidate(name, ov, budget, cpu)
+    for name, ov in CANDIDATES:
+        res = bench.run_train_probe(name, ov, budget, [], cpu=cpu)
         results.append(res)
         print(json.dumps(res), flush=True)
 
-    ok = [r for r in results if r.get("mfu_pct") is not None]
-    if ok:
-        best = max(ok, key=lambda r: r["mfu_pct"])
+    best = bench.probe_winner(results)
+    if best:
         print(json.dumps({"summary": "scan_probe_winner",
-                          "candidate": best["candidate"],
+                          "probe": best["probe"],
                           "mfu_pct": best["mfu_pct"]}))
     return 0
 

@@ -37,7 +37,12 @@ from orion_tpu.runtime.probe import probe_device  # noqa: E402
 QUEUE = [
     ("bench", [sys.executable, str(ROOT / "bench.py")], 3600),
     ("tpu_parity", [sys.executable, str(ROOT / "tools/tpu_parity.py")], 2700),
-    ("scan_probe", [sys.executable, str(ROOT / "tools/scan_probe.py")], 5400),
+    # A/B of the scan-grouping / selective-remat knobs, one subprocess per
+    # probe with its own compile budget (bench.TRAIN_PROBES): supersedes
+    # tools/scan_probe.py in the queue — same subprocess-budget discipline,
+    # plus the scan_group x remat=names grid this round's PERF.md asks for.
+    ("bench_probes",
+     [sys.executable, str(ROOT / "bench.py"), "--probe", "all"], 9000),
     ("moe_dispatch",
      [sys.executable, str(ROOT / "tools/moe_dispatch_bench.py")], 1800),
     ("longcontext",
