@@ -556,6 +556,16 @@ class InferenceConfig:
     # most recent match is the draft.
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # Draft-density gate: enter a verify step only when at least this
+    # many live decode slots actually drafted (clamped to the live count,
+    # so a fully-drafting batch always verifies). A step where ANY slot
+    # drafts otherwise runs as a verify step for the WHOLE batch, costing
+    # non-drafting co-tenants their multi-step decode window — one
+    # repetitive tenant can tax a mostly-non-repetitive batch with one
+    # host round-trip per token (the PERF.md scheduling tradeoff). 1 =
+    # any draft triggers verification (the prior behavior); gated-off
+    # steps are counted as ``spec_gated_steps`` in reset_timing().
+    spec_min_draft_slots: int = 1
 
 
 @dataclass(frozen=True)

@@ -319,6 +319,35 @@ class InferenceEngine:
         if self.icfg.speculative:
             from orion_tpu.infer.spec_decode import NgramProposer
 
+            if self.icfg.spec_min_draft_slots < 1:
+                raise ValueError(
+                    f"inference.spec_min_draft_slots="
+                    f"{self.icfg.spec_min_draft_slots} must be >= 1"
+                )
+            if resolve_impl(self.mcfg.kernels)[0]:
+                # Pallas verify path: reject a verify width the ragged
+                # paged-attention kernel cannot hold in VMEM at engine
+                # init — a config error naming the knob, instead of a
+                # Mosaic allocation failure mid-serving.
+                from orion_tpu.ops.pallas.ragged_paged_attention import (
+                    check_verify_fit,
+                )
+
+                # Per-SHARD head counts: under tp the kernel runs inside
+                # a head-sharded shard_map with K/tp kv heads per device
+                # (divisibility already validated above), so the fit is
+                # per shard — whole-model counts would reject configs
+                # that actually fit.
+                tp = self.mesh.shape["tp"] if self.mesh is not None else 1
+                check_verify_fit(
+                    self.icfg.speculate_tokens + 1,
+                    n_heads=self.mcfg.n_heads // tp,
+                    n_kv_heads=self.mcfg.n_kv_heads // tp,
+                    head_dim=self.mcfg.resolved_head_dim,
+                    page_size=self.psz,
+                    kv_quant=self.icfg.kv_quant,
+                    dtype_itemsize=jnp.dtype(self.mcfg.dtype).itemsize,
+                )
             self._spec = NgramProposer(
                 speculate_tokens=self.icfg.speculate_tokens,
                 max_n=self.icfg.spec_ngram_max,
@@ -525,7 +554,9 @@ class InferenceEngine:
         (prefix_hits/misses/hit_rate, cached_tokens, inserted/evicted/cow
         pages), and with inference.speculative the speculation counters
         (spec_drafted/accepted/rolled_back/emitted, spec_acceptance_rate,
-        verify_steps, verify_slot_steps, spec_tokens_per_verify)."""
+        verify_steps, verify_slot_steps, spec_tokens_per_verify, and
+        spec_gated_steps — steps the draft-density gate sent back to the
+        plain window)."""
         out, self.timing = self.timing, self._zero_timing()
         out["decode_window"] = self.decode_window
         if self._pcache is not None:
@@ -1164,14 +1195,23 @@ class InferenceEngine:
         length is capped per request by the adaptive state, the context
         window (write positions must stay below max_seq_len) and the
         request's remaining token budget (drafting past max_new_tokens
-        is guaranteed rollback)."""
+        is guaranteed rollback).
+
+        Draft-density gate (inference.spec_min_draft_slots): a verify
+        step costs every NON-drafting co-tenant its multi-step decode
+        window (one host round-trip per token on that step), so when
+        fewer than the threshold of live slots drafted — clamped to the
+        live count, a fully-drafting batch always verifies — the step is
+        gated back to the plain window (counted: spec_gated_steps). The
+        discarded drafts were free to produce and are re-proposed next
+        step if the repetition persists."""
         if not cands:
             return None
         extra = (
             self._pcache.token_paths() if self._pcache is not None else ()
         )
         drafts: dict[int, list[int]] = {}
-        any_draft = False
+        n_drafted = 0
         for r in cands:
             pos = int(self.seq_lens[r.slot])
             limit = min(
@@ -1183,8 +1223,13 @@ class InferenceEngine:
                 if limit > 0 else []
             )
             drafts[r.slot] = d
-            any_draft = any_draft or bool(d)
-        return drafts if any_draft else None
+            n_drafted += bool(d)
+        if not n_drafted:
+            return None
+        if n_drafted < min(self.icfg.spec_min_draft_slots, len(cands)):
+            self.spec_stats.gated_steps += 1
+            return None
+        return drafts
 
     def _build_verify_rows(
         self, reqs: list[Request], drafts: dict[int, list[int]]

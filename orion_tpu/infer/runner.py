@@ -361,9 +361,11 @@ def _decode_layer(
     """One transformer layer of single-token decode: fused-write ragged
     paged attention (pallas) or scatter + masked pool gather (xla).
 
-    LOCKSTEP: _verify_layer is this body's xla branch generalized from 1
-    to W queries per slot, and speculative byte-identity (greedy spec-on
-    == spec-off, enforced by tests/test_spec_decode.py across kv_quant /
+    LOCKSTEP: _verify_layer is this body generalized from 1 to W queries
+    per slot, branch for branch (its pallas branch is the multi-query
+    ragged paged-attention kernel, its xla branch this scatter+gather
+    with a W dim), and speculative byte-identity (greedy spec-on ==
+    spec-off, enforced by tests/test_spec_decode.py across kv_quant /
     SWA / prefix-cache compositions) holds only while the two agree
     op-for-op on the write/gather/dequant/mask math — fix both together.
     """
@@ -535,9 +537,11 @@ def _verify_ctx(
     chunks these start MID-PAGE (the cursor is arbitrary), so per-token
     (page, offset) pairs come from the page table exactly as decode's do;
     unlike decode there are W of them per row. Padding positions (j >=
-    lens, inactive rows, past max_seq_len) scatter to scratch page 0 —
-    never clamped onto a real page, so a row near the context limit cannot
-    clobber its own final KV slot the way a clamp would.
+    lens, inactive rows, past max_seq_len) scatter to scratch page 0 on
+    the xla branch — never clamped onto a real page, so a row near the
+    context limit cannot clobber its own final KV slot the way a clamp
+    would; the pallas kernel excludes them from its in-kernel merge
+    instead. Both leave every real page untouched.
     """
     B = seq_lens.shape[0]
     kp = cache["k"]
@@ -560,11 +564,26 @@ def _verify_ctx(
     # the same dispatch included — they sit at positions seq_lens..q_pos).
     kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
     kv_base_mask = kv_arange <= q_pos[:, :, None]           # [B, W, P*psz]
+
+    from orion_tpu.ops._dispatch import resolve_impl
+
+    use_pallas, interpret = resolve_impl(cfg.kernels)
+    # Ragged-kernel view of the same layout: the cursor and a real-token
+    # count clamped so start + lens - 1 stays inside the context — for
+    # live rows the engine already guarantees it (drafts are capped at
+    # max_seq_len - 1 - cursor), so the clamp is an identity there;
+    # inactive/mid-prefill rows carry all-zero page-table rows and land
+    # on the scratch page, the same sink the XLA body's `valid` redirect
+    # uses.
+    start = jnp.minimum(seq_lens, max_seq_len - 1).astype(jnp.int32)
+    k_lens = jnp.clip(jnp.minimum(lens, max_seq_len - start), 1, W)
     return dict(
         B=B, W=W, psz=psz, NP=NP, P=P, quant="k_scale" in cache,
         page_table=page_table, positions=wp, q_pos=q_pos,
         page_idx=page_idx, offset=offset,
         kv_arange=kv_arange, kv_base_mask=kv_base_mask,
+        start=start, k_lens=k_lens,
+        use_pallas=use_pallas, interpret=interpret,
     )
 
 
@@ -579,18 +598,26 @@ def _verify_layer(
     mesh: Optional[jax.sharding.Mesh],
 ) -> tuple[jax.Array, Cache]:
     """One transformer layer of batched draft verification: the decode
-    body's scatter-then-masked-gather generalized from one query to W per
-    slot — every draft position's K/V lands in the pool first (quantized
-    under kv_quant, exactly as a sequential decode would have written it),
-    then each query attends the gathered context up to its own position.
-    One pass over this layer's weights serves all W positions of all
-    slots; position i's logits therefore match the i-th sequential decode
-    step's bit-for-bit, which is what makes greedy acceptance exact.
+    body generalized from one query to W per slot — every draft
+    position's K/V lands in the pool first (quantized under kv_quant,
+    exactly as a sequential decode would have written it), then each
+    query attends the context up to its own position. One pass over this
+    layer's weights serves all W positions of all slots; position i's
+    logits therefore match the i-th sequential decode step's bit-for-bit,
+    which is what makes greedy acceptance exact.
 
-    LOCKSTEP: this is _decode_layer's xla branch with a W dimension —
-    any change to either body's write/gather/dequant/mask math must land
-    in both, or the greedy spec-on == spec-off equivalence suite
-    (tests/test_spec_decode.py) fails."""
+    Pallas branch: the multi-query ragged paged-attention kernel
+    (ops/pallas/ragged_paged_attention.py) — the fused-write W=1 decode
+    kernel generalized to W ragged queries, writing all lens[b] drafts'
+    K/V in-kernel (aliased pools, quantized in-kernel under kv_quant with
+    the shared common.quantize_kv, so its written bytes match this body's
+    xla scatter bit-for-bit). XLA branch: scatter + masked padded-context
+    gather, the reference.
+
+    LOCKSTEP: this is _decode_layer with a W dimension, branch for
+    branch — any change to either body's write/gather/dequant/mask math
+    must land in both, or the greedy spec-on == spec-off equivalence
+    suite (tests/test_spec_decode.py) fails."""
     B, W, psz, NP, P = ctx["B"], ctx["W"], ctx["psz"], ctx["NP"], ctx["P"]
     quant = ctx["quant"]
     page_table = ctx["page_table"]
@@ -600,45 +627,74 @@ def _verify_layer(
     h = _norm(x, bp["attn_norm"], cfg)
     q, k, v = qkv_proj(h, bp["attn"], cfg, ctx["positions"])
     K, H = k.shape[2], k.shape[3]
-    rows = l * NP + page_idx                       # [B, W]
-    if quant:
-        from orion_tpu.infer.kv_cache import quantize_kv
-
-        kq, ks = quantize_kv(k)                    # [B,W,K,H] i8, [B,W,K]
-        vq, vs = quantize_kv(v)
-        cc["k"] = cc["k"].at[rows, :, offset].set(kq)
-        cc["v"] = cc["v"].at[rows, :, offset].set(vq)
-        cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
-        cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
-    else:
-        cc["k"] = cc["k"].at[rows, :, offset].set(k)
-        cc["v"] = cc["v"].at[rows, :, offset].set(v)
-    # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather (the
-    # just-written draft K/V reads back out of the pool, so under kv_quant
-    # each query attends its drafts DEQUANTIZED — the decode path's exact
-    # numerics).
-    k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
-    v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
-    if quant:
-        ksc = cc["k_scale"][l * NP + page_table][..., :psz]
-        vsc = cc["v_scale"][l * NP + page_table][..., :psz]
-        k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
-            0, 1, 3, 2)[..., None]
-        v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
-            0, 1, 3, 2)[..., None]
-        k_ctx = k_ctx.astype(q.dtype)
-        v_ctx = v_ctx.astype(q.dtype)
-    k_ctx = k_ctx.reshape(B, P * psz, K, H)
-    v_ctx = v_ctx.reshape(B, P * psz, K, H)
-    kv_mask = ctx["kv_base_mask"]
-    if win is not None:
-        kv_mask = kv_mask & (
-            ctx["kv_arange"] >= (ctx["q_pos"] - win + 1)[:, :, None]
+    if ctx["use_pallas"]:
+        # Multi-query ragged paged attention: one kernel walks each
+        # slot's pages once for all W queries (page DMAs amortized W×),
+        # writes every real draft's K/V in place through the aliased
+        # pools, and masks queries causally among the W new positions —
+        # the verify step stops being the one step type that abandons
+        # the fused kernels. Rows with all-zero page-table entries
+        # (inactive / mid-prefill slots) read and write only the
+        # reserved scratch page, like the xla branch's `valid` redirect.
+        from orion_tpu.ops.pallas.ragged_paged_attention import (
+            ragged_paged_attention,
         )
-    out = attention_xla(
-        q, k_ctx, v_ctx, causal=False, mask=kv_mask,
-        logit_softcap=cfg.attn_logit_softcap,
-    )
+
+        res = ragged_paged_attention(
+            q, cc["k"], cc["v"], page_table, ctx["start"], ctx["k_lens"],
+            layer_base=l * NP,
+            k_new=k, v_new=v,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=win,
+            interpret=ctx["interpret"],
+            k_scale=cc.get("k_scale"),
+            v_scale=cc.get("v_scale"),
+            mesh=mesh,
+        )
+        if quant:
+            out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
+        else:
+            out, cc["k"], cc["v"] = res
+    else:
+        rows = l * NP + page_idx                   # [B, W]
+        if quant:
+            from orion_tpu.infer.kv_cache import quantize_kv
+
+            kq, ks = quantize_kv(k)                # [B,W,K,H] i8, [B,W,K]
+            vq, vs = quantize_kv(v)
+            cc["k"] = cc["k"].at[rows, :, offset].set(kq)
+            cc["v"] = cc["v"].at[rows, :, offset].set(vq)
+            cc["k_scale"] = cc["k_scale"].at[rows, :, offset].set(ks)
+            cc["v_scale"] = cc["v_scale"].at[rows, :, offset].set(vs)
+        else:
+            cc["k"] = cc["k"].at[rows, :, offset].set(k)
+            cc["v"] = cc["v"].at[rows, :, offset].set(v)
+        # [B, P, K, psz, H] -> [B, P*psz, K, H] padded-context gather (the
+        # just-written draft K/V reads back out of the pool, so under
+        # kv_quant each query attends its drafts DEQUANTIZED — the decode
+        # path's exact numerics).
+        k_ctx = cc["k"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+        v_ctx = cc["v"][l * NP + page_table].transpose(0, 1, 3, 2, 4)
+        if quant:
+            ksc = cc["k_scale"][l * NP + page_table][..., :psz]
+            vsc = cc["v_scale"][l * NP + page_table][..., :psz]
+            k_ctx = k_ctx.astype(jnp.float32) * ksc.transpose(
+                0, 1, 3, 2)[..., None]
+            v_ctx = v_ctx.astype(jnp.float32) * vsc.transpose(
+                0, 1, 3, 2)[..., None]
+            k_ctx = k_ctx.astype(q.dtype)
+            v_ctx = v_ctx.astype(q.dtype)
+        k_ctx = k_ctx.reshape(B, P * psz, K, H)
+        v_ctx = v_ctx.reshape(B, P * psz, K, H)
+        kv_mask = ctx["kv_base_mask"]
+        if win is not None:
+            kv_mask = kv_mask & (
+                ctx["kv_arange"] >= (ctx["q_pos"] - win + 1)[:, :, None]
+            )
+        out = attention_xla(
+            q, k_ctx, v_ctx, causal=False, mask=kv_mask,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
     a = out_proj(out, bp["attn"], cfg)
     if cfg.post_norms:
         a = _norm(a, bp["post_attn_norm"], cfg)
@@ -698,11 +754,14 @@ def verify_step(
     sampling.spec_verify_sample; the engine walks each row to its first
     rejection and emits ``accepted drafts + one bonus/correction token``.
 
-    The body is the XLA decode path (scatter + masked gather) on BOTH
-    kernel settings: the ragged paged-attention kernel is single-query
-    with a fused single-token write, and a multi-query variant is a
-    kernel project of its own (PERF.md). The gather costs what an xla
-    decode step costs — paid once per W tokens instead of once per token.
+    The body follows the decode step's resolve_impl switch: under
+    kernels='pallas' each layer runs the multi-query ragged
+    paged-attention kernel (page walk + in-kernel fused write for all W
+    positions — the pool gather never materializes, and the page DMAs
+    amortize over the W queries); under 'xla' it is the decode body's
+    scatter + masked gather with a W dimension, kept as the reference.
+    Either way the per-position logits match sequential decode on the
+    same kernel setting bit-for-bit.
     """
     from orion_tpu.infer.sampling import spec_verify_sample
 

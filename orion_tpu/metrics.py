@@ -118,7 +118,11 @@ class SpecDecodeStats:
     per-slot dispatch opportunities, and ``emitted`` every token a verify
     step emitted (accepted drafts + the per-slot bonus/correction token) —
     so ``emitted / verify_slot_steps`` is the decode tokens-per-dispatch
-    the speculation bought (1.0 means it bought nothing)."""
+    the speculation bought (1.0 means it bought nothing). ``gated_steps``
+    counts steps where SOMETHING drafted but fewer slots than
+    ``inference.spec_min_draft_slots``, so the engine ran the plain
+    decode window instead of a whole-batch verify step (the
+    draft-density gate; drafts discarded there are not in ``drafted``)."""
 
     drafted: int = 0
     accepted: int = 0
@@ -126,6 +130,7 @@ class SpecDecodeStats:
     emitted: int = 0
     verify_steps: int = 0
     verify_slot_steps: int = 0
+    gated_steps: int = 0
 
     @property
     def acceptance_rate(self) -> float:
@@ -148,6 +153,7 @@ class SpecDecodeStats:
             "verify_steps": self.verify_steps,
             "verify_slot_steps": self.verify_slot_steps,
             "spec_tokens_per_verify": self.tokens_per_verify,
+            "spec_gated_steps": self.gated_steps,
         }
 
 

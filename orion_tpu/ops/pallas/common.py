@@ -45,12 +45,22 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
     Lives here (plain jnp, Pallas-kernel-legal) because it is the SINGLE
     definition both the jnp cache paths (infer/kv_cache.py re-exports it)
-    and the paged kernel's fused in-kernel write must share — decode and
-    prefill quantization have to agree bit-for-bit.
+    and the paged kernels' fused in-kernel writes must share — decode,
+    prefill, and speculative verification have to agree bit-for-bit.
+
+    The scale is an explicit multiply by the f32 constant 1/127, NOT a
+    division by 127: XLA keeps a true f32 divide on the host path but
+    rewrites constant divides to reciprocal multiplies inside compiled /
+    interpreted Pallas bodies, and the two round differently by 1 ULP on
+    some inputs — enough to flip a greedy argmax between the kernel and
+    jnp cache paths. One fixed multiply lowers identically everywhere.
     """
     import jax.numpy as jnp
+    import numpy as np
 
-    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) * np.float32(
+        1.0 / 127.0
+    )
     s = jnp.maximum(s, 1e-8)
     q = jnp.round(x.astype(jnp.float32) / s[..., None])
     return q.astype(jnp.int8), s

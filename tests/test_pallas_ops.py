@@ -639,3 +639,301 @@ def test_paged_attention_rejects_degenerate_window():
             q, pool, pool, jnp.zeros((1, 2), jnp.int32),
             jnp.zeros(1, jnp.int32), window=0, interpret=True,
         )
+
+
+# -- multi-query ragged paged attention (speculative verification) ----------
+
+
+def _ragged_reference(q, k_pool, v_pool, page_table, start, lens,
+                      k_new=None, v_new=None, window=None, softcap=None):
+    """runner._verify_layer's xla semantics: scatter all real tokens
+    (padding tokens park on a dummy extra row — the engine's scratch page
+    stand-in, since these tests use page 0 as a real page), gather the
+    padded context, mask per query (own position + earlier same-dispatch
+    drafts; optional sliding window)."""
+    from orion_tpu.ops.attention import attention_xla
+
+    B, W, N, H = q.shape
+    K, psz = k_pool.shape[1], k_pool.shape[2]
+    P = page_table.shape[1]
+    npg = k_pool.shape[0]
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    q_pos = start[:, None] + steps                         # [B, W]
+    if k_new is not None:
+        valid = steps < lens[:, None]
+        k_pool = jnp.concatenate(
+            [k_pool, jnp.zeros((1,) + k_pool.shape[1:], k_pool.dtype)])
+        v_pool = jnp.concatenate(
+            [v_pool, jnp.zeros((1,) + v_pool.shape[1:], v_pool.dtype)])
+        rows = jnp.where(
+            valid, page_table[jnp.arange(B)[:, None], q_pos // psz], npg
+        )
+        off = q_pos % psz
+        k_pool = k_pool.at[rows, :, off].set(k_new)[:npg]
+        v_pool = v_pool.at[rows, :, off].set(v_new)[:npg]
+    k_ctx = k_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    v_ctx = v_pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        B, P * psz, K, H)
+    kv = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    mask = kv <= q_pos[:, :, None]
+    if window is not None:
+        mask &= kv >= (q_pos - window + 1)[:, :, None]
+    out = attention_xla(
+        q, k_ctx, v_ctx, causal=False, mask=mask, logit_softcap=softcap
+    )
+    return out, k_pool, v_pool
+
+
+def _ragged_case(key=2, W=5, N=8, K=2):
+    B, H, psz, num_pages = 3, 64, 16, 32
+    ks = jax.random.split(jax.random.key(key), 6)
+    q = jax.random.normal(ks[0], (B, W, N, H), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (num_pages, K, psz, H), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (num_pages, K, psz, H), jnp.float32)
+    k_new = jax.random.normal(ks[3], (B, W, K, H), jnp.float32)
+    v_new = jax.random.normal(ks[4], (B, W, K, H), jnp.float32)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 0, 22]], jnp.int32
+    )
+    # Full-width from zero / single mid-page / ragged near the table end.
+    start = jnp.asarray([0, 13, 59], jnp.int32)
+    lens = jnp.asarray([W, 1, 3], jnp.int32)
+    return q, k_pool, v_pool, k_new, v_new, page_table, start, lens
+
+
+def _assert_real_rows_close(got, want, lens, atol=2e-5):
+    got, want = np.asarray(got), np.asarray(want)
+    for b in range(len(lens)):
+        w = int(lens[b])
+        np.testing.assert_allclose(got[b, :w], want[b, :w], atol=atol)
+
+
+@pytest.mark.parametrize("gqa", [(8, 8), (8, 2), (4, 1)])
+def test_ragged_paged_attention_matches_gather(gqa):
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    N, K = gqa
+    q, kp, vp, _, _, pt, start, lens = _ragged_case(N=N, K=K)
+    ref, _, _ = _ragged_reference(q, kp, vp, pt, start, lens)
+    out = ragged_paged_attention(q, kp, vp, pt, start, lens, interpret=True)
+    _assert_real_rows_close(out, ref, lens)
+
+
+def test_ragged_paged_attention_fused_write():
+    """In-kernel multi-token KV write == external scatter + attention:
+    outputs match and the written pools are BITWISE equal (padding tokens
+    and clamped tail revisits leave every unwritten position untouched).
+    The causal structure among the W new positions rides the same check:
+    each query's reference context includes the earlier drafts of its own
+    dispatch."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case()
+    ref, kpr, vpr = _ragged_reference(q, kp, vp, pt, start, lens, kn, vn)
+    out, kp2, vp2 = ragged_paged_attention(
+        q, kp, vp, pt, start, lens, k_new=kn, v_new=vn, interpret=True
+    )
+    _assert_real_rows_close(out, ref, lens)
+    assert (np.asarray(kp2) == np.asarray(kpr)).all()
+    assert (np.asarray(vp2) == np.asarray(vpr)).all()
+
+    # Page-boundary straddle: rows whose W tokens span two pages (the
+    # merge must select per-token target pages, and the tail clamp must
+    # re-apply the LAST page's merge on revisits).
+    start2 = jnp.asarray([14, 30, 46], jnp.int32)
+    lens2 = jnp.asarray([5, 4, 2], jnp.int32)
+    ref2, kpr2, vpr2 = _ragged_reference(
+        q, kp, vp, pt, start2, lens2, kn, vn)
+    out2, kp3, vp3 = ragged_paged_attention(
+        q, kp, vp, pt, start2, lens2, k_new=kn, v_new=vn, interpret=True
+    )
+    _assert_real_rows_close(out2, ref2, lens2)
+    assert (np.asarray(kp3) == np.asarray(kpr2)).all()
+    assert (np.asarray(vp3) == np.asarray(vpr2)).all()
+
+
+def test_ragged_paged_attention_int8_bitwise():
+    """int8 pools: the in-kernel quantized write of all W drafts must be
+    BITWISE the host-side common.quantize_kv (values and per-(token,
+    kv-head) scales) — the property that keeps speculative acceptance
+    numerics identical to sequential decode under kv_quant — and the
+    attention must match the dequantized-pool reference."""
+    from orion_tpu.infer.kv_cache import SCALE_LANES, quantize_kv
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kf, vf, kn, vn, pt, start, lens = _ragged_case(key=11)
+    num_pages, K, psz, H = kf.shape
+    kq, ks = quantize_kv(kf.transpose(0, 2, 1, 3))
+    vq, vs = quantize_kv(vf.transpose(0, 2, 1, 3))
+    kq, vq = kq.transpose(0, 2, 1, 3), vq.transpose(0, 2, 1, 3)
+    k_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(ks.transpose(0, 2, 1))
+    v_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(vs.transpose(0, 2, 1))
+
+    out, kp2, vp2, ks2, vs2 = ragged_paged_attention(
+        q, kq, vq, pt, start, lens, k_new=kn, v_new=vn,
+        k_scale=k_sc, v_scale=v_sc, interpret=True,
+    )
+    knq, kns = quantize_kv(kn)            # [B,W,K,H] i8, [B,W,K]
+    vnq, vns = quantize_kv(vn)
+    B = q.shape[0]
+    written = set()
+    for b in range(B):
+        for j in range(int(lens[b])):
+            p = int(start[b]) + j
+            r, o = int(pt[b, p // psz]), p % psz
+            written.add((r, o))
+            assert (np.asarray(kp2[r, :, o]) == np.asarray(knq[b, j])).all()
+            assert (np.asarray(vp2[r, :, o]) == np.asarray(vnq[b, j])).all()
+            assert (np.asarray(ks2[r, :, o]) == np.asarray(kns[b, j])).all()
+            assert (np.asarray(vs2[r, :, o]) == np.asarray(vns[b, j])).all()
+    # Every unwritten pool/scale position is untouched.
+    kp2n, kqn = np.asarray(kp2), np.asarray(kq)
+    ks2n, kscn = np.asarray(ks2), np.asarray(k_sc)
+    for r in range(num_pages):
+        for o in range(psz):
+            if (r, o) not in written:
+                assert (kp2n[r, :, o] == kqn[r, :, o]).all()
+                assert (ks2n[r, :, o] == kscn[r, :, o]).all()
+
+    # Attention vs the explicitly dequantized reference.
+    kd = kq.astype(jnp.float32) * k_sc[:, :, :psz][..., None]
+    vd = vq.astype(jnp.float32) * v_sc[:, :, :psz][..., None]
+    ref, _, _ = _ragged_reference(
+        q, kd, vd, pt, start, lens,
+        knq.astype(jnp.float32) * kns[..., None],
+        vnq.astype(jnp.float32) * vns[..., None],
+    )
+    _assert_real_rows_close(out, ref, lens)
+
+
+@pytest.mark.parametrize("window", [5, 20, 1000])
+def test_ragged_paged_attention_sliding_window(window):
+    """Per-query sliding windows over the W new positions: pages behind
+    the EARLIEST query's window skip (clamped DMAs); later queries'
+    tighter windows ride the mask."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case(key=7)
+    ref, _, _ = _ragged_reference(
+        q, kp, vp, pt, start, lens, kn, vn, window=window)
+    out, _, _ = ragged_paged_attention(
+        q, kp, vp, pt, start, lens, k_new=kn, v_new=vn, window=window,
+        interpret=True,
+    )
+    _assert_real_rows_close(out, ref, lens)
+
+
+def test_ragged_paged_attention_softcap():
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case(key=5)
+    q = q * 4                    # push logits into the tanh's curved region
+    ref, _, _ = _ragged_reference(
+        q, kp, vp, pt, start, lens, kn, vn, softcap=20.0)
+    out, _, _ = ragged_paged_attention(
+        q, kp, vp, pt, start, lens, k_new=kn, v_new=vn,
+        logit_softcap=20.0, interpret=True,
+    )
+    _assert_real_rows_close(out, ref, lens)
+
+
+def test_ragged_w1_matches_paged_kernel_bitwise():
+    """W=1 degenerates to the single-query fused-write kernel BITWISE
+    (output and written pools): the ragged kernel really is the same
+    kernel generalized, so spec-on pallas serving reproduces the W=1
+    pallas decode stream exactly."""
+    from orion_tpu.ops.pallas.paged_attention import paged_attention
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, _ = _ragged_case()
+    l1 = jnp.ones(q.shape[0], jnp.int32)
+    oA, kpA, vpA = ragged_paged_attention(
+        q[:, :1], kp, vp, pt, start, l1,
+        k_new=kn[:, :1], v_new=vn[:, :1], interpret=True,
+    )
+    oB, kpB, vpB = paged_attention(
+        q[:, 0], kp, vp, pt, start, k_new=kn[:, 0], v_new=vn[:, 0],
+        interpret=True,
+    )
+    assert (np.asarray(oA[:, 0]) == np.asarray(oB)).all()
+    assert (np.asarray(kpA) == np.asarray(kpB)).all()
+    assert (np.asarray(vpA) == np.asarray(vpB)).all()
+
+
+def test_ragged_paged_attention_layer_base():
+    """Traced layer_base over a flat 2-layer pool (the layer-scan calling
+    convention): reads and fused writes both land in layer 1's rows."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case()
+    num_pages = kp.shape[0]
+    kp2 = jnp.concatenate([kp, kp * 0.5], axis=0)
+    vp2 = jnp.concatenate([vp, vp * 0.5], axis=0)
+    ref, kpr, vpr = _ragged_reference(
+        q, kp * 0.5, vp * 0.5, pt, start, lens, kn, vn)
+    out, kp3, vp3 = jax.jit(
+        lambda q, kp, vp, kn, vn: ragged_paged_attention(
+            q, kp, vp, pt, start, lens,
+            layer_base=jnp.int32(num_pages), k_new=kn, v_new=vn,
+            interpret=True,
+        )
+    )(q, kp2, vp2, kn, vn)
+    _assert_real_rows_close(out, ref, lens)
+    # Layer 0's rows untouched; layer 1's equal the reference scatter.
+    assert (np.asarray(kp3[:num_pages]) == np.asarray(kp)).all()
+    assert (np.asarray(kp3[num_pages:]) == np.asarray(kpr)).all()
+    assert (np.asarray(vp3[num_pages:]) == np.asarray(vpr)).all()
+
+
+def test_ragged_verify_fit_check():
+    """The VMEM fit estimate rejects hopeless verify widths with an error
+    naming the config knob, and passes the serving-scale shapes the
+    kernel is built for."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        check_verify_fit,
+        verify_vmem_bytes,
+    )
+
+    shape = dict(n_heads=32, n_kv_heads=8, head_dim=128, page_size=64)
+    check_verify_fit(7, kv_quant=None, dtype_itemsize=2, **shape)
+    check_verify_fit(7, kv_quant="int8", **shape)
+    with pytest.raises(ValueError, match="speculate_tokens"):
+        check_verify_fit(512, kv_quant=None, dtype_itemsize=2, **shape)
+    # The estimate grows with W (the q/out/new-token blocks scale).
+    small = verify_vmem_bytes(
+        2, kv_itemsize=2, quant=False, **shape)
+    big = verify_vmem_bytes(
+        64, kv_itemsize=2, quant=False, **shape)
+    assert big > small
+
+
+def test_ragged_paged_attention_rejects_degenerate_window():
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q = jnp.zeros((1, 2, 4, 64))
+    pool = jnp.zeros((4, 2, 16, 64))
+    with pytest.raises(ValueError, match="window"):
+        ragged_paged_attention(
+            q, pool, pool, jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
+            window=0, interpret=True,
+        )

@@ -368,8 +368,11 @@ def test_preemption_mid_stream_rollback():
 
 def test_bench_smoke():
     """tools/spec_decode_bench.py --smoke (the tier-1 wiring): greedy
-    spec-on/off streams identical and the self-repetitive workload shows
-    > 1.3 decode tokens per verify dispatch with the counters visible."""
+    spec-on/off streams identical on BOTH verify kernel paths (xla
+    scatter+gather and the multi-query ragged paged-attention kernel via
+    the interpreter), the self-repetitive workload shows > 1.3 decode
+    tokens per verify dispatch, and the per-step device/host ms columns
+    are present so the kernel-path win is measured, not asserted."""
     import json
     import pathlib
     import subprocess
@@ -385,11 +388,164 @@ def test_bench_smoke():
     lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
     verdict = lines[-1]
     assert verdict["greedy_identical"] is True, lines
+    assert verdict["pallas_greedy_identical"] is True, lines
     assert verdict["spec_tokens_per_verify"] > 1.3, lines
     assert verdict["acceptance_rate"] > 0.5, lines
+    assert set(verdict["verify_dev_ms"]) == {"xla", "pallas"}, verdict
     by_mode = {d["mode"]: d for d in lines[:-1]}
-    assert by_mode["speculative"]["steps"] < by_mode["baseline"]["steps"]
-    assert by_mode["speculative"]["spec_rolled_back"] == (
-        by_mode["speculative"]["spec_drafted"]
-        - by_mode["speculative"]["spec_accepted"]
+    for path in ("xla", "pallas"):
+        spec, base = by_mode[f"speculative_{path}"], by_mode[f"baseline_{path}"]
+        assert spec["verify_path"] == path
+        assert spec["steps"] < base["steps"]
+        assert spec["spec_rolled_back"] == (
+            spec["spec_drafted"] - spec["spec_accepted"]
+        )
+        assert "dev_ms_per_step" in spec and "host_ms_per_step" in spec
+
+
+# -- pallas verify path (multi-query ragged paged-attention kernel) ---------
+
+PALLAS = ["model.kernels=pallas_interpret"]
+
+
+def test_equivalence_greedy_pallas_verify():
+    """ISSUE 5 acceptance: with kernels=pallas the verify step runs the
+    multi-query ragged paged-attention kernel instead of falling back to
+    the XLA scatter+gather body — and the greedy spec-on stream stays
+    byte-identical to the spec-off pallas engine (whose decode is the W=1
+    fused-write kernel), with the rollback footprint unchanged (every
+    live slot holds exactly its cursor-covering pages after each step)."""
+    cfg_on, params = _setup(overrides=PALLAS)
+    cfg_off, _ = _setup(overrides=PALLAS, spec=False)
+    ref = InferenceEngine(cfg_off, params).generate(MIX, 20)
+    eng = InferenceEngine(cfg_on, params)
+    for p in MIX:
+        eng.submit(p, 20)
+    out = {}
+    while eng.has_work():
+        for r in eng.step():
+            out[r.rid] = r.generated
+        for r in eng.slots:
+            if r is not None and not r.done:
+                want = (int(eng.seq_lens[r.slot]) - 1) // eng.psz + 1
+                assert len(r.pages) == want, (len(r.pages), want)
+    assert [out[i] for i in sorted(out)] == ref
+    t = eng.reset_timing()
+    assert t["verify_steps"] > 0 and t["spec_accepted"] > 0, t
+
+
+def test_draft_density_gating():
+    """inference.spec_min_draft_slots: a lone repetitive tenant in a
+    mostly-non-repetitive batch no longer drags every co-tenant into
+    whole-batch verify steps — under-threshold steps run the plain decode
+    window (counted as spec_gated_steps), the threshold clamps to the
+    live-slot count (a solo drafting request still verifies), and the
+    greedy stream is unchanged either way."""
+    gate = ["inference.spec_min_draft_slots=3"]
+    cfg_gated, params = _setup(overrides=gate)
+    cfg_off, _ = _setup(spec=False)
+    ref = InferenceEngine(cfg_off, params).generate(MIX, 24)
+    eng = InferenceEngine(cfg_gated, params)
+    assert eng.generate(MIX, 24) == ref
+    t = eng.reset_timing()
+    assert t["spec_gated_steps"] > 0, t
+    # MIX has at most 2 concurrently-drafting slots, so threshold 3 is
+    # met only once the batch has shrunk to the drafting slots alone —
+    # verification still happens (the clamp), just later.
+    assert t["verify_steps"] > 0, t
+    # Solo request: the gate clamps to the live count and verification
+    # proceeds (otherwise a 1-slot batch could never speculate).
+    solo = InferenceEngine(cfg_gated, params)
+    solo.generate([REP], 24)
+    ts = solo.reset_timing()
+    assert ts["verify_steps"] > 0 and ts["spec_gated_steps"] == 0, ts
+    # Validation: the knob must be >= 1.
+    bad, _ = _setup(overrides=["inference.spec_min_draft_slots=0"])
+    with pytest.raises(ValueError, match="spec_min_draft_slots"):
+        InferenceEngine(bad, params)
+
+
+def test_spec_pallas_vmem_validation():
+    """speculative + pallas kernels + a verify width the ragged kernel
+    cannot hold in VMEM is a config error at engine init naming the knob,
+    not a Mosaic allocation failure mid-serving."""
+    cfg, params = _setup()
+    bad, _ = _setup(
+        overrides=PALLAS + ["inference.speculate_tokens=100000"])
+    with pytest.raises(ValueError, match="speculate_tokens"):
+        InferenceEngine(bad, params)
+    # The same width is fine on the xla path (no kernel, no VMEM).
+    big_xla, _ = _setup(overrides=["inference.speculate_tokens=64"])
+    InferenceEngine(big_xla, params)
+
+
+# slow (tier-1 budget, round 10): heavy pallas-interpret engine pairs.
+# Tier-1 keeps the plain pallas verify equivalence + the kernel-level
+# ragged/int8/SWA unit tests in tests/test_pallas_ops.py; these pin the
+# same compositions end-to-end through the engine.
+
+
+@pytest.mark.slow
+def test_equivalence_pallas_kv_quant():
+    """int8 pool on the pallas verify path: the kernel quantizes all W
+    drafts in-kernel with the shared common.quantize_kv, so acceptance
+    numerics equal the sequential W=1-kernel decode bit-for-bit."""
+    q = PALLAS + ["inference.kv_quant=int8"]
+    cfg_on, params = _setup(overrides=q)
+    cfg_off, _ = _setup(overrides=q, spec=False)
+    assert InferenceEngine(cfg_on, params).generate(MIX, 16) == (
+        InferenceEngine(cfg_off, params).generate(MIX, 16)
     )
+
+
+@pytest.mark.slow
+def test_equivalence_pallas_sliding_window():
+    """SWA on the pallas verify path: per-query windows + the behind-
+    window page clamp, against the spec-off W=1 pallas kernel."""
+    swa = PALLAS + ["model.sliding_window=20"]
+    cfg_on, params = _setup(overrides=swa)
+    cfg_off, _ = _setup(overrides=swa, spec=False)
+    assert InferenceEngine(cfg_on, params).generate(MIX, 16) == (
+        InferenceEngine(cfg_off, params).generate(MIX, 16)
+    )
+
+
+@pytest.mark.slow
+def test_equivalence_pallas_gemma2():
+    """Gemma-2 family on the pallas verify path: logit softcap +
+    interleaved local/global windows (static per scan position) + post
+    norms, spec-on == spec-off."""
+    cfg_on, params = _setup("tiny-gemma2", overrides=PALLAS)
+    cfg_off, _ = _setup("tiny-gemma2", overrides=PALLAS, spec=False)
+    assert InferenceEngine(cfg_on, params).generate(MIX, 12) == (
+        InferenceEngine(cfg_off, params).generate(MIX, 12)
+    )
+
+
+@pytest.mark.slow
+def test_equivalence_pallas_chunked_prefill():
+    """Chunked prefill x speculation on the pallas path: the mixed
+    verify step runs flash chunk rows and ragged-kernel verify rows over
+    the same carried pool in one dispatch."""
+    ch = PALLAS + ["inference.chunked_prefill=true",
+                   "inference.prefill_chunk_tokens=16"]
+    cfg_on, params = _setup(overrides=ch)
+    cfg_off, _ = _setup(overrides=ch, spec=False)
+
+    def run(cfg):
+        eng = InferenceEngine(cfg, params)
+        out = {}
+        eng.submit(REP, 24)
+        eng.step()
+        eng.step()
+        eng.submit(list(range(1, 97)), 4)
+        while eng.has_work():
+            for r in eng.step():
+                out[r.rid] = r.generated
+        return out, eng
+
+    got, eng = run(cfg_on)
+    ref, _ = run(cfg_off)
+    assert got == ref
+    t = eng.reset_timing()
+    assert t["mixed_steps"] > 0 and t["spec_accepted"] > 0, t

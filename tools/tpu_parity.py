@@ -11,6 +11,11 @@ compares fwd + grads against the xla reference ops at bench-like shapes:
     (a two-block ring-style merge, exactly how parallel/sequence.py uses it)
   - paged decode attention: gather parity, fused in-kernel KV write,
     sliding window, ragged tail lengths (no VJP — decode is inference-only)
+  - multi-query ragged paged attention (speculative verification):
+    W in {2, 5} x {float, int8 kv_quant} x {full, sliding window}, fused
+    multi-token write with BITWISE pool/scale checks vs the host-side
+    quantize — the compiled-Mosaic validation of the verify fast path
+    (the pytest suite pins the same cases in interpret mode only)
   - fused RMSNorm, fused RoPE
 
 The pytest suite runs these kernels only through the Pallas interpreter on
@@ -158,6 +163,146 @@ def paged_checks() -> bool:
     ok &= check("paged int8 fwd", out_q,
                 reference(q, kd.astype(jnp.bfloat16),
                           vd.astype(jnp.bfloat16)), 2e-2)
+    return ok
+
+
+def ragged_paged_checks() -> bool:
+    """Compiled multi-query ragged paged attention (the speculative-
+    verification kernel) vs the scatter + masked-gather reference:
+    W in {2, 5} queries per slot x {float, int8} pools x {full, sliding
+    window}, page-boundary straddles, ragged per-slot lengths, in-kernel
+    fused multi-token writes (pool bytes bitwise; int8 scales bitwise vs
+    the shared host-side quantize)."""
+    from orion_tpu.infer.kv_cache import SCALE_LANES, quantize_kv
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    ok = True
+    N, K, B, H, psz, P, num_pages = 8, 4, 4, 128, 64, 4, 64
+    keys = jax.random.split(jax.random.key(13), 6)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.bfloat16)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.bfloat16)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 63, 22], [8, 40, 33, 6]],
+        jnp.int32,
+    )
+
+    def reference(q, kp, vp, start, lens, k_new, v_new, window=None):
+        # Scatter every real token (padding tokens park on a dummy extra
+        # row), gather, mask per query incl. same-dispatch causality.
+        W = q.shape[1]
+        steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+        q_pos = start[:, None] + steps
+        valid = steps < lens[:, None]
+        kp = jnp.concatenate(
+            [kp, jnp.zeros((1,) + kp.shape[1:], kp.dtype)])
+        vp = jnp.concatenate(
+            [vp, jnp.zeros((1,) + vp.shape[1:], vp.dtype)])
+        rows = jnp.where(
+            valid, page_table[jnp.arange(B)[:, None], q_pos // psz],
+            num_pages,
+        )
+        off = q_pos % psz
+        kp = kp.at[rows, :, off].set(k_new.astype(kp.dtype))[:num_pages]
+        vp = vp.at[rows, :, off].set(v_new.astype(vp.dtype))[:num_pages]
+        k_ctx = kp[page_table].transpose(0, 1, 3, 2, 4).reshape(
+            B, P * psz, K, H)
+        v_ctx = vp[page_table].transpose(0, 1, 3, 2, 4).reshape(
+            B, P * psz, K, H)
+        kv = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+        mask = kv <= q_pos[:, :, None]
+        if window is not None:
+            mask &= kv >= (q_pos - window + 1)[:, :, None]
+        out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=mask)
+        return jnp.where(valid[:, :, None, None], out, 0.0), kp, vp
+
+    for W in (2, 5):
+        q = jax.random.normal(keys[0], (B, W, N, H), jnp.bfloat16)
+        k_new = jax.random.normal(keys[3], (B, W, K, H), jnp.bfloat16)
+        v_new = jax.random.normal(keys[4], (B, W, K, H), jnp.bfloat16)
+        # Ragged: 1 real token, straddle, from-zero, near the table end.
+        start = jnp.asarray([0, 93, 127, P * psz - W], jnp.int32)
+        lens = jnp.asarray([W, 1, min(W, 3), W], jnp.int32)
+        steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+        vmask = (steps < lens[:, None])[:, :, None, None]
+
+        def masked(o):
+            return jnp.where(vmask, o.astype(jnp.float32), 0.0)
+
+        # Float pools: fwd + bitwise written pools.
+        ref_o, kp_r, vp_r = reference(
+            q, k_pool, v_pool, start, lens, k_new, v_new)
+        out, kp_w, vp_w = jax.jit(
+            lambda q, kp, vp, kn, vn, st, ln: ragged_paged_attention(
+                q, kp, vp, page_table, st, ln, k_new=kn, v_new=vn,
+                interpret=INTERP)
+        )(q, k_pool, v_pool, k_new, v_new, start, lens)
+        ok &= check(f"ragged W={W} fwd", masked(out), ref_o, 2e-2)
+        ok &= check(f"ragged W={W} k_pool", kp_w, kp_r, 1e-6)
+        ok &= check(f"ragged W={W} v_pool", vp_w, vp_r, 1e-6)
+
+        # Sliding window (behind-window page clamp + per-query mask).
+        ref_w, _, _ = reference(
+            q, k_pool, v_pool, start, lens, k_new, v_new, window=100)
+        out_w = jax.jit(
+            lambda q, kp, vp, kn, vn, st, ln: ragged_paged_attention(
+                q, kp, vp, page_table, st, ln, k_new=kn, v_new=vn,
+                window=100, interpret=INTERP)[0]
+        )(q, k_pool, v_pool, k_new, v_new, start, lens)
+        ok &= check(f"ragged W={W} window fwd", masked(out_w), ref_w, 2e-2)
+
+        # int8 pools (inference.kv_quant): in-kernel quantized write of
+        # all W drafts — scales and bytes bitwise vs the host quantize —
+        # and dequantizing attention, with and without the window.
+        kq, ks = quantize_kv(k_pool.transpose(0, 2, 1, 3))
+        vq, vs = quantize_kv(v_pool.transpose(0, 2, 1, 3))
+        kq, vq = kq.transpose(0, 2, 1, 3), vq.transpose(0, 2, 1, 3)
+        k_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                         ).at[:, :, :psz].set(ks.transpose(0, 2, 1))
+        v_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                         ).at[:, :, :psz].set(vs.transpose(0, 2, 1))
+        knq, kns = quantize_kv(k_new)
+        vnq, vns = quantize_kv(v_new)
+        kd = kq.astype(jnp.float32) * k_sc[:, :, :psz][..., None]
+        vd = vq.astype(jnp.float32) * v_sc[:, :, :psz][..., None]
+        for wname, win in (("", None), (" window", 100)):
+            ref_q, _, _ = reference(
+                q, kd.astype(jnp.bfloat16), vd.astype(jnp.bfloat16),
+                start, lens,
+                knq.astype(jnp.float32) * kns[..., None],
+                vnq.astype(jnp.float32) * vns[..., None], window=win)
+            out_q, kp_q, vp_q, ks_q, vs_q = jax.jit(
+                lambda q, kp, vp, ksc, vsc, kn, vn, st, ln, w=win:
+                ragged_paged_attention(
+                    q, kp, vp, page_table, st, ln, k_new=kn, v_new=vn,
+                    k_scale=ksc, v_scale=vsc, window=w, interpret=INTERP)
+            )(q, kq, vq, k_sc, v_sc, k_new, v_new, start, lens)
+            ok &= check(
+                f"ragged W={W} int8{wname} fwd", masked(out_q), ref_q, 3e-2)
+            if win is None:
+                # Written bytes/scales: bitwise vs the host-side
+                # quantization at every real (slot, draft) position.
+                import numpy as np
+
+                exact = True
+                for b in range(B):
+                    for j in range(int(lens[b])):
+                        p = int(start[b]) + j
+                        r, o = int(page_table[b, p // psz]), p % psz
+                        exact &= bool(
+                            (np.asarray(kp_q[r, :, o])
+                             == np.asarray(knq[b, j])).all()
+                            and (np.asarray(ks_q[r, :, o])
+                                 == np.asarray(kns[b, j])).all()
+                            and (np.asarray(vp_q[r, :, o])
+                                 == np.asarray(vnq[b, j])).all()
+                            and (np.asarray(vs_q[r, :, o])
+                                 == np.asarray(vns[b, j])).all()
+                        )
+                status = "OK" if exact else "FAIL"
+                print(f"{status} ragged W={W} int8 write bitwise")
+                ok &= exact
     return ok
 
 
@@ -331,6 +476,7 @@ def main() -> int:
         ok &= check(f"flash lse merge d{name}", gp_, gx_, 4e-2)
 
     ok &= paged_checks()
+    ok &= ragged_paged_checks()
 
     # RMSNorm.
     x = jax.random.normal(jax.random.key(0), (2, 512, 2048), jnp.bfloat16)
