@@ -123,24 +123,39 @@ def main(argv: list[str] | None = None) -> int:
             # the params) before the engine possibly quantizes.
             del restored
 
+    from orion_tpu.runtime.fault import PreemptionHandler
+
     engine = InferenceEngine(cfg, params, eos_id=args.eos_id)
     # The engine owns (a possibly int8-quantized copy of) the params from
     # here; keeping this reference alive would pin the full-precision
     # masters in device memory for the whole serving loop.
     del params
-    if args.stream:
-        collected: dict[int, list[int]] = {}
-        for rid, toks in engine.stream(prompts, args.max_new_tokens):
-            collected.setdefault(rid, []).extend(toks)
-            if toks:
-                print(f"request {rid} += {toks}", flush=True)
-        # Every request yields at least once (possibly []), and rids are
-        # assigned in submission order, so this realigns with prompts.
-        outputs = [collected[rid] for rid in sorted(collected)]
-    else:
-        outputs = engine.generate(prompts, args.max_new_tokens)
-    for i, (prompt, out) in enumerate(zip(prompts, outputs)):
-        print(f"request {i}: prompt={prompt} -> generated={out}")
+    # Graceful shutdown (README "Robustness"): SIGTERM only flips a flag;
+    # at the next step boundary the engine stops admission, sheds the wait
+    # queue with typed outcomes, FINISHES every live request — donating
+    # their pages to the prefix cache exactly as normal completion does —
+    # and this process exits 0 instead of dying mid-dispatch.
+    with PreemptionHandler() as handler:
+        reqs = [engine.submit_request(p, args.max_new_tokens) for p in prompts]
+        emitted = [0] * len(reqs)
+        while engine.has_work():
+            if handler.preempted:
+                print("SIGTERM: draining (admission stopped, live "
+                      "requests finishing)", flush=True)
+                engine.drain()
+                break
+            engine.step()
+            if args.stream:
+                for req, n in zip(reqs, emitted):
+                    if len(req.generated) > n:
+                        print(f"request {req.rid} += {req.generated[n:]}",
+                              flush=True)
+                emitted = [len(r.generated) for r in reqs]
+    engine.close()
+    for i, (prompt, req) in enumerate(zip(prompts, reqs)):
+        out = req.generated
+        tag = "" if req.outcome == "completed" else f" [{req.outcome}]"
+        print(f"request {i}: prompt={prompt} -> generated={out}{tag}")
         if args.byte_tokenizer:
             print(f"  text: {bytes(t % 256 for t in out).decode('utf-8', 'replace')!r}")
     return 0

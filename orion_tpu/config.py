@@ -566,6 +566,78 @@ class InferenceConfig:
     # any draft triggers verification (the prior behavior); gated-off
     # steps are counted as ``spec_gated_steps`` in reset_timing().
     spec_min_draft_slots: int = 1
+    # --- Fault tolerance / graceful degradation (README "Robustness") ---
+    # Bounded admission queue: when a submit would push the wait queue past
+    # this many requests, the lowest-priority (then nearest-deadline, then
+    # newest) candidate — possibly the incoming request itself — is SHED
+    # with a typed "shed" outcome instead of queueing unboundedly. None =
+    # unbounded (the pre-robustness behavior).
+    queue_limit: Optional[int] = None
+    # Default per-request deadline, in seconds from submit();
+    # submit(deadline_s=...) overrides per request. Expired requests are
+    # reaped at step boundaries — pages released, full pages donated to the
+    # prefix cache — exactly as preemption does. None = no deadline.
+    default_deadline_s: Optional[float] = None
+    # Degradation ladder rung 1: a failed Pallas dispatch retries once on
+    # the XLA reference path (same math, partitioner-visible) before the
+    # step is declared failed. No-op when kernels="xla" already.
+    dispatch_fallback: bool = True
+    # Device-side NaN/Inf logit guard: the decode/verify/mixed programs
+    # additionally return a per-slot all-finite flag (riding the existing
+    # token fetch — no extra round trip) and the engine QUARANTINES a
+    # non-finite slot: that request errors ("error:nan"), its private pages
+    # are scrubbed and released WITHOUT prefix-cache donation, and its
+    # neighbors' outputs stay byte-identical to a fault-free run. Off by
+    # default so the compiled programs stay bit-for-bit the pre-guard ones.
+    nan_guard: bool = False
+    # Degradation ladder rung 2: after this many verify-path dispatch
+    # faults, speculation auto-disables for the rest of the engine's life
+    # (SpecDecodeStats.disabled_reason records why); decoding continues on
+    # the plain window.
+    spec_fault_limit: int = 3
+    # A failed step (every dispatch path exhausted) is contained — the
+    # engine logs it, counts it (reset_timing "failed_steps") and carries
+    # on — until this many CONSECUTIVE steps fail, at which point the
+    # fault is clearly not transient and the engine re-raises.
+    max_step_faults: int = 4
+    # Serving step watchdog: if no engine step completes within this many
+    # seconds, flag a stall. Detection-only — the slow step's results are
+    # KEPT and the step is counted as "stalled_steps" when it eventually
+    # completes (deadline expiry handles the SLO consequences at the next
+    # boundary); the process always survives, unlike
+    # train.watchdog_action="abort". A dispatch that errors rather than
+    # stalls is the failed-step path, not this one. None disables.
+    watchdog_timeout_s: Optional[float] = None
+
+    def __post_init__(self):
+        # Domain checks only (each field alone), matching ModelConfig's
+        # rule: dotted CLI overrides apply one field at a time, so
+        # cross-field constraints live in the engine.
+        if self.queue_limit is not None and self.queue_limit < 1:
+            raise ValueError(
+                f"inference.queue_limit={self.queue_limit} must be >= 1 "
+                f"(or none for unbounded)"
+            )
+        if self.default_deadline_s is not None and self.default_deadline_s <= 0:
+            raise ValueError(
+                f"inference.default_deadline_s={self.default_deadline_s} "
+                f"must be > 0 (or none)"
+            )
+        if self.spec_fault_limit is None or self.spec_fault_limit < 1:
+            raise ValueError(
+                f"inference.spec_fault_limit={self.spec_fault_limit} "
+                f"must be >= 1"
+            )
+        if self.max_step_faults is None or self.max_step_faults < 1:
+            raise ValueError(
+                f"inference.max_step_faults={self.max_step_faults} "
+                f"must be >= 1"
+            )
+        if self.watchdog_timeout_s is not None and self.watchdog_timeout_s <= 0:
+            raise ValueError(
+                f"inference.watchdog_timeout_s={self.watchdog_timeout_s} "
+                f"must be > 0 (or none)"
+            )
 
 
 @dataclass(frozen=True)

@@ -10,6 +10,7 @@ slots and KV pages free up; batching never changes any request's tokens
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import logging
 import time
@@ -28,7 +29,9 @@ from orion_tpu.infer.kv_cache import (
     copy_page,
     init_cache,
     pages_per_seq,
+    poison_page,
     rollback_pages,
+    scrub_pages,
 )
 from orion_tpu.infer.runner import (
     decode_window,
@@ -38,7 +41,17 @@ from orion_tpu.infer.runner import (
     verify_step,
 )
 from orion_tpu.infer.sampling import sample
-from orion_tpu.metrics import PrefixCacheStats, SpecDecodeStats
+from orion_tpu.metrics import (
+    PrefixCacheStats,
+    RobustnessStats,
+    SpecDecodeStats,
+)
+from orion_tpu.runtime.fault import (
+    DispatchFault,
+    FaultInjector,
+    InjectedFault,
+    Watchdog,
+)
 
 log = logging.getLogger("orion_tpu.infer")
 
@@ -72,6 +85,18 @@ class Request:
     temperature: Optional[float] = None
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # SLO class (higher = more important): admission and page-pressure
+    # preemption prefer high-priority requests; overload shedding evicts
+    # the lowest class first.
+    priority: int = 0
+    # Absolute time.monotonic() deadline (None = none). Expired requests
+    # are reaped at step boundaries with a typed "expired" outcome.
+    deadline: Optional[float] = None
+    # Typed terminal outcome: "" while live, then exactly one of
+    # "completed" | "expired" | "cancelled" | "shed" | "error:<kind>".
+    # Every submitted request surfaces from step() with an outcome — no
+    # silent drops.
+    outcome: str = ""
     # scheduler state
     slot: Optional[int] = None
     pages: list[int] = field(default_factory=list)
@@ -116,6 +141,7 @@ class InferenceEngine:
         *,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        fault_injector: Optional[FaultInjector] = None,
     ):
         self.cfg = cfg
         self.mcfg = cfg.model
@@ -238,6 +264,49 @@ class InferenceEngine:
         self._prefill_span = 0.0
         self.timing = self._zero_timing()
 
+        # -- Fault tolerance (runtime/fault.py; README "Robustness") -------
+        self._injector = fault_injector
+        self.robust = RobustnessStats()
+        self.step_no = 0            # completed step() calls; FaultSpec.step
+        self._consec_failed = 0     # consecutive failed steps (bounded)
+        self._spec_faults = 0       # verify-path dispatch faults (lifetime)
+        self._spec_disabled = False
+        self._guard = self.icfg.nan_guard
+        self.draining = False       # drain(): admission stopped
+        # XLA reference programs, built lazily per dispatch name the first
+        # time a Pallas dispatch fails (inference.dispatch_fallback).
+        self._xla_fallbacks: dict[str, Any] = {}
+        # Quarantine primitives: poison is the NaN fault injection
+        # (FaultSpec kind="nan"), scrub zeroes a quarantined request's
+        # private pages before they return to the free list.
+        self._poison = jax.jit(
+            partial(
+                poison_page,
+                n_layers=self.mcfg.n_layers,
+                num_pages=self.icfg.num_pages,
+            ),
+            donate_argnums=(0,),
+        )
+        self._scrub = jax.jit(
+            partial(
+                scrub_pages,
+                n_layers=self.mcfg.n_layers,
+                num_pages=self.icfg.num_pages,
+            ),
+            donate_argnums=(0,),
+        )
+        # Serving step watchdog: flags stalls (counted in reset_timing's
+        # stalled_steps); never aborts the process — a stalled step fails
+        # the step, not the engine (unlike train.watchdog_action="abort").
+        self._watchdog: Optional[Watchdog] = None
+        if self.icfg.watchdog_timeout_s is not None:
+            self._watchdog = Watchdog(
+                self.icfg.watchdog_timeout_s,
+                on_stall=lambda elapsed: log.error(
+                    "serving watchdog: step stalled for %.1fs", elapsed
+                ),
+            ).start()
+
         # Per-slot sampling params (inference.* defaults; submit() can
         # override per request, vLLM-style).
         self.slot_temp = np.full(self.max_batch, self.icfg.temperature,
@@ -245,60 +314,27 @@ class InferenceEngine:
         self.slot_top_k = np.full(self.max_batch, self.icfg.top_k, np.int32)
         self.slot_top_p = np.full(self.max_batch, self.icfg.top_p,
                                   np.float32)
-        self._decode = jax.jit(
-            partial(
-                decode_window,
-                cfg=self.mcfg,
-                max_seq_len=self.icfg.max_seq_len,
-                mesh=self.mesh,
-            ),
-            donate_argnums=(1,),
+        # Dispatch programs, built by the shared _jit_program factory (the
+        # XLA-fallback degradation ladder rebuilds the same programs with
+        # kernels="xla" on demand, so primary and fallback can never drift):
+        #   decode           — the fused decode window; the "_defaults"
+        #                      variant binds python-scalar sampling params
+        #                      so sample()'s greedy short-circuit compiles
+        #                      no sampling machinery (no [B, V] sort).
+        #   prefill          — one specialization per (padded bucket length,
+        #                      padded batch size) pair, keyed by jit.
+        #   mixed            — unified mixed prefill+decode
+        #                      (inference.chunked_prefill): ONE dispatch per
+        #                      engine step while prompt chunks are in
+        #                      flight.
+        self._decode = self._jit_program("decode", self.mcfg, self.mesh)
+        self._decode_defaults = self._jit_program(
+            "decode_defaults", self.mcfg, self.mesh
         )
-        # Static specialization for the common all-defaults case: binding
-        # python scalars via partial keeps them trace-time constants, so
-        # sample()'s greedy short-circuit applies and the decode program
-        # compiles no sampling machinery (no [B, V] sort per token).
-        self._decode_defaults = jax.jit(
-            partial(
-                decode_window,
-                cfg=self.mcfg,
-                max_seq_len=self.icfg.max_seq_len,
-                mesh=self.mesh,
-                temperature=self.icfg.temperature,
-                top_k=self.icfg.top_k,
-                top_p=self.icfg.top_p,
-            ),
-            donate_argnums=(1,),
-        )
-        # One prefill specialization per (padded bucket length, padded batch
-        # size) pair — both static shapes; the jit cache keys on them
-        # automatically. Admission batches same-bucket prompts into one
-        # dispatch and rounds the batch up to a power of two to bound the
-        # number of specializations.
-        self._prefill = jax.jit(
-            partial(prefill_step, cfg=self.mcfg, mesh=self.mesh),
-            donate_argnums=(1,),
-        )
-        # Unified mixed prefill+decode programs (inference.chunked_prefill):
-        # ONE dispatch per engine step while prompt chunks are in flight —
-        # a single-token decode for every live slot fused with up to
-        # prefill_chunk_tokens of prompt tail. Defaults specialization as
-        # for decode: all-greedy traffic compiles no sampling machinery.
-        self._mixed = jax.jit(
-            partial(
-                mixed_step, cfg=self.mcfg,
-                max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-            ),
-            donate_argnums=(1,),
-        )
-        self._mixed_defaults = jax.jit(
-            partial(
-                mixed_step, cfg=self.mcfg,
-                max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-                temperature=self.icfg.temperature,
-                top_k=self.icfg.top_k, top_p=self.icfg.top_p,
-            ),
-            donate_argnums=(1,),
+        self._prefill = self._jit_program("prefill", self.mcfg, self.mesh)
+        self._mixed = self._jit_program("mixed", self.mcfg, self.mesh)
+        self._mixed_defaults = self._jit_program(
+            "mixed_defaults", self.mcfg, self.mesh
         )
         # Fixed key for mixed steps with no live decode slot: those steps
         # must not advance the engine PRNG stream (sampled chunked-vs-
@@ -353,39 +389,252 @@ class InferenceEngine:
                 max_n=self.icfg.spec_ngram_max,
                 min_n=self.icfg.spec_ngram_min,
             )
-            self._verify = jax.jit(
-                partial(
-                    verify_step, cfg=self.mcfg,
-                    max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-                ),
-                donate_argnums=(1,),
-            )
-            self._verify_defaults = jax.jit(
-                partial(
-                    verify_step, cfg=self.mcfg,
-                    max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-                    temperature=self.icfg.temperature,
-                    top_k=self.icfg.top_k, top_p=self.icfg.top_p,
-                ),
-                donate_argnums=(1,),
+            self._verify = self._jit_program("verify", self.mcfg, self.mesh)
+            self._verify_defaults = self._jit_program(
+                "verify_defaults", self.mcfg, self.mesh
             )
             if self.chunked:
-                self._mixed_verify = jax.jit(
-                    partial(
-                        mixed_verify_step, cfg=self.mcfg,
-                        max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-                    ),
-                    donate_argnums=(1,),
+                self._mixed_verify = self._jit_program(
+                    "mixed_verify", self.mcfg, self.mesh
                 )
-                self._mixed_verify_defaults = jax.jit(
-                    partial(
-                        mixed_verify_step, cfg=self.mcfg,
-                        max_seq_len=self.icfg.max_seq_len, mesh=self.mesh,
-                        temperature=self.icfg.temperature,
-                        top_k=self.icfg.top_k, top_p=self.icfg.top_p,
-                    ),
-                    donate_argnums=(1,),
+                self._mixed_verify_defaults = self._jit_program(
+                    "mixed_verify_defaults", self.mcfg, self.mesh
                 )
+
+    # -- dispatch + degradation ladder ------------------------------------
+
+    _PROGRAM_FNS = {
+        "prefill": prefill_step,
+        "decode": decode_window,
+        "mixed": mixed_step,
+        "verify": verify_step,
+        "mixed_verify": mixed_verify_step,
+    }
+
+    def _jit_program(self, name: str, mcfg, mesh):
+        """Build one jitted dispatch program. ``name`` is a coarse path
+        stem optionally suffixed "_defaults" (python-scalar sampling params
+        bound as trace-time constants — the sort-free greedy
+        specialization). The SAME factory builds the XLA fallback programs
+        (kernels="xla", mesh=None), so the two paths share every static
+        binding and can never drift."""
+        icfg = self.icfg
+        is_default = name.endswith("_defaults")
+        stem = name[: -len("_defaults")] if is_default else name
+        fn = self._PROGRAM_FNS[stem]
+        if stem == "prefill":
+            kw: dict[str, Any] = dict(cfg=mcfg, mesh=mesh)
+        else:
+            kw = dict(
+                cfg=mcfg, max_seq_len=icfg.max_seq_len, mesh=mesh,
+                nan_guard=self._guard,
+            )
+        if is_default:
+            kw.update(
+                temperature=icfg.temperature,
+                top_k=icfg.top_k,
+                top_p=icfg.top_p,
+            )
+        return jax.jit(partial(fn, **kw), donate_argnums=(1,))
+
+    def _fallback_program(self, name: str):
+        """The XLA reference program for ``name`` (degradation ladder rung
+        1), or None when no fallback applies — the primary already runs
+        XLA, or inference.dispatch_fallback is off. Built lazily on the
+        first fault and cached; mesh=None because the XLA ops partition
+        from the params' shardings alone."""
+        from orion_tpu.ops._dispatch import resolve_impl
+
+        if not self.icfg.dispatch_fallback:
+            return None
+        if not resolve_impl(self.mcfg.kernels)[0]:
+            return None
+        fb = self._xla_fallbacks.get(name)
+        if fb is None:
+            mcfg_xla = dataclasses.replace(self.mcfg, kernels="xla")
+            fb = self._jit_program(name, mcfg_xla, None)
+            self._xla_fallbacks[name] = fb
+        return fb
+
+    def _run_dispatch(self, path: str, name: str, *args):
+        """Run one device dispatch with the fault-tolerance envelope: the
+        injection points (stall sleeps; dispatch exceptions raised BEFORE
+        the primary call, so engine/cache state is untouched and retry is
+        sound), then on ANY failure one retry on the XLA reference path.
+        Raises DispatchFault(path) when every path is exhausted — the
+        engine fails the step, not the process.
+
+        The primary result is blocked on HERE so that execute-time device
+        errors (async dispatch defers them to the first fetch) surface
+        inside this envelope instead of crashing the caller's device_get;
+        the engine fetches the step's tokens immediately afterwards
+        anyway, so no overlap is lost. Fallback scope: trace/compile/
+        lowering failures (the dominant Pallas fault class) and injected
+        faults retry cleanly; an EXECUTE-time failure may already have
+        consumed the donated cache buffer, in which case the fallback
+        double-faults and the episode is contained as a failed step."""
+        inj = self._injector
+        if inj is not None:
+            st = inj.take("stall", self.step_no, path)
+            if st is not None:
+                log.warning(
+                    "injected %.2fs stall in %s dispatch (step %d)",
+                    st.stall_s, path, self.step_no,
+                )
+                time.sleep(st.stall_s)
+        try:
+            if inj is not None and (
+                inj.take("dispatch", self.step_no, path) is not None
+            ):
+                raise InjectedFault(
+                    f"injected {path} dispatch fault (step {self.step_no})"
+                )
+            out = getattr(self, "_" + name)(*args)
+            jax.block_until_ready(out)
+            return out
+        except Exception as e:
+            self.robust.dispatch_faults += 1
+            if path in ("verify", "mixed_verify"):
+                # Degradation ladder rung 2 counts PRIMARY verify faults
+                # here — before the fallback — so a persistently broken
+                # verify kernel disables speculation even when every
+                # episode is absorbed by a successful XLA retry (otherwise
+                # the engine would pay a doomed primary attempt + fallback
+                # on every verify step forever).
+                self._note_spec_fault(e)
+            fb = self._fallback_program(name)
+            if fb is None:
+                raise DispatchFault(
+                    path, f"{type(e).__name__}: {e}"
+                ) from e
+            log.warning(
+                "%s dispatch failed (%s: %s); retrying once on the XLA "
+                "reference path", path, type(e).__name__, e,
+            )
+            try:
+                out = fb(*args)
+                jax.block_until_ready(out)
+            except Exception as e2:
+                self.robust.dispatch_faults += 1
+                raise DispatchFault(
+                    path, f"xla fallback failed too: {e2}"
+                ) from e2
+            self.robust.dispatch_fallbacks += 1
+            return out
+
+    def _note_spec_fault(self, e: Exception) -> None:
+        """Degradation ladder rung 2: count a verify-path PRIMARY dispatch
+        fault (whether or not the XLA fallback then absorbed it); past
+        inference.spec_fault_limit, speculation auto-disables for the
+        engine's lifetime (SpecDecodeStats.disabled_reason) and decoding
+        continues on the plain window."""
+        self._spec_faults += 1
+        log.warning(
+            "speculative verify dispatch fault %d/%d: %s",
+            self._spec_faults, self.icfg.spec_fault_limit, e,
+        )
+        if (
+            self._spec_faults >= self.icfg.spec_fault_limit
+            and not self._spec_disabled
+        ):
+            self._spec_disabled = True
+            self.spec_stats.disabled_reason = (
+                f"auto-disabled after {self._spec_faults} verify "
+                f"dispatch faults"
+            )
+            log.error(
+                "speculative decoding %s", self.spec_stats.disabled_reason
+            )
+
+    def _maybe_inject_nan(self) -> None:
+        """FaultSpec kind="nan": poison the victim's newest attended
+        PRIVATE page with NaN. The poison flows through the real attention
+        into exactly that slot's logits (no other slot reads its pages);
+        the nan_guard quarantine is then exercised end-to-end."""
+        inj = self._injector
+        if inj is None:
+            return
+        spec = inj.take("nan", self.step_no)
+        if spec is None:
+            return
+        cands = [
+            r for r in self.slots
+            if r is not None and not r.done
+            and (spec.rid is None or r.rid == spec.rid)
+        ]
+        if not cands:
+            log.warning("nan injection at step %d found no victim",
+                        self.step_no)
+            return
+        req = min(cands, key=lambda r: r.admit_seq)
+        # Walk back from the cursor's page: the newest written position is
+        # always attended, and shared (refcount > 1) prefix pages must stay
+        # clean — they are other requests' data.
+        pos = max(int(self.seq_lens[req.slot]) - 1, 0)
+        for i in range(min(pos // self.psz, len(req.pages) - 1), -1, -1):
+            p = req.pages[i]
+            if p is not None and self.alloc.refcount(p) == 1:
+                log.warning(
+                    "injecting NaN into page %d of request %d (step %d)",
+                    p, req.rid, self.step_no,
+                )
+                self.cache = self._poison(self.cache, jnp.int32(p))
+                return
+        log.warning("nan injection: request %d has no private page",
+                    req.rid)
+
+    def _quarantine(self, req: Request, reason: str) -> None:
+        """Contain a poisoned slot: the request errors with a typed
+        outcome, its private pages are SCRUBBED (stale NaNs must not leak
+        to the page's next tenant) and released with NO prefix-cache
+        donation; neighbors never read its pages, so their outputs stay
+        byte-identical to a fault-free run."""
+        log.error("quarantining request %d (%s)", req.rid, reason)
+        priv = [
+            p for p in req.pages
+            if p is not None and self.alloc.refcount(p) == 1
+        ]
+        if priv:
+            pad = priv + [0] * (self.pages_per_seq - len(priv))
+            self.cache = self._scrub(
+                self.cache, jnp.asarray(pad, jnp.int32)
+            )
+        req.done = True
+        req.outcome = f"error:{reason}"
+        self.robust.quarantined += 1
+        self._teardown_slot(req, 0)   # n_cached=0: donate nothing
+        self._just_finished.append(req)
+
+    def _reap_expired(self) -> None:
+        """Step-boundary deadline sweep: expired requests — waiting or
+        active, mid-prefill or mid-decode — terminate with outcome
+        "expired"; active ones release pages with prefix-cache donation
+        exactly as preemption does (the _reap path)."""
+        now = time.monotonic()
+        if self.waiting and any(
+            r.deadline is not None and now >= r.deadline
+            for r in self.waiting
+        ):
+            keep: deque[Request] = deque()
+            for r in self.waiting:
+                if r.deadline is not None and now >= r.deadline:
+                    r.done = True
+                    r.outcome = "expired"
+                    self.robust.expired += 1
+                    self._just_finished.append(r)
+                else:
+                    keep.append(r)
+            self.waiting = keep
+        for r in self.slots:
+            if (
+                r is not None and not r.done
+                and r.deadline is not None and now >= r.deadline
+            ):
+                log.info("request %d deadline expired (slot %d)",
+                         r.rid, r.slot)
+                r.done = True
+                r.outcome = "expired"
+                self.robust.expired += 1
 
     # -- public API --------------------------------------------------------
 
@@ -397,8 +646,21 @@ class InferenceEngine:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         """Queue a request; returns its id.
+
+        ``deadline_s`` (seconds from now; default
+        inference.default_deadline_s) bounds the request's life: once past
+        it, the request is reaped at the next step boundary with outcome
+        "expired". ``priority`` (higher = more important) orders admission,
+        page-pressure preemption (low classes evict first) and overload
+        shedding. With inference.queue_limit set, an over-limit submit
+        SHEDS the lowest-priority / nearest-deadline / newest candidate —
+        possibly this very request — with outcome "shed" instead of
+        queueing unboundedly; the shed request still surfaces from the
+        next step().
 
         Note: any non-None sampling override switches the WHOLE decode batch
         to the sort-based sampling program (a [B, V] sort per token for every
@@ -407,6 +669,26 @@ class InferenceEngine:
         batch, not just this request. Greedy-default traffic stays on the
         sort-free specialized program.
         """
+        return self.submit_request(
+            prompt, max_new_tokens, temperature=temperature, top_k=top_k,
+            top_p=top_p, deadline_s=deadline_s, priority=priority,
+        ).rid
+
+    def submit_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        *,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        priority: int = 0,
+    ) -> Request:
+        """submit() returning the live Request object instead of its id —
+        the CLI/bench/driver surface: callers poll ``.generated`` for
+        incremental tokens and read the typed ``.outcome`` at the end.
+        Same arguments and validation as submit()."""
         if not len(prompt):
             raise ValueError("empty prompt")
         if temperature is not None and temperature < 0.0:
@@ -456,6 +738,10 @@ class InferenceEngine:
                 f"has {usable}; raise inference.num_pages or lower "
                 f"max_new_tokens"
             )
+        if deadline_s is None:
+            deadline_s = self.icfg.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(
             rid=next(self._rid),
             prompt=list(map(int, prompt)),
@@ -463,9 +749,80 @@ class InferenceEngine:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            priority=int(priority),
+            deadline=(
+                time.monotonic() + deadline_s
+                if deadline_s is not None else None
+            ),
         )
+        if self.draining:
+            # Admission is stopped (SIGTERM drain): typed shed, never
+            # queued — the caller still sees the request surface.
+            self._shed(req, "draining")
+            return req
+        qlim = self.icfg.queue_limit
+        if qlim is not None and len(self.waiting) >= qlim:
+            # Overload: shed the least defensible candidate — lowest
+            # priority first, then the nearest (most infeasible) deadline,
+            # then the newest arrival — which may be the incoming request.
+            # In-flight requests (admitted once, or carrying generated
+            # tokens — see _in_flight) are never victims: "shed" means
+            # never admitted (RobustnessStats contract).
+            victim = min(
+                [r for r in self.waiting if not self._in_flight(r)] + [req],
+                key=lambda r: (
+                    r.priority,
+                    r.deadline if r.deadline is not None else float("inf"),
+                    -r.rid,
+                ),
+            )
+            self._shed(victim, f"queue full ({qlim})")
+            if victim is not req:
+                self.waiting.remove(victim)
+                self.waiting.append(req)
+            return req
         self.waiting.append(req)
-        return req.rid
+        return req
+
+    @staticmethod
+    def _in_flight(req: Request) -> bool:
+        """A queued request that has RUN: admitted at least once and not
+        since un-claimed (admit_seq >= 0 — preemption and fault unwinds
+        keep it), or carrying generated tokens from a previous residency
+        (survives even an admission pool-fault deferral, which resets
+        admit_seq). In-flight requests are exempt from overload shedding
+        and are finished — not shed — by drain()."""
+        return req.admit_seq >= 0 or bool(req.generated)
+
+    def _shed(self, req: Request, why: str) -> None:
+        log.warning("shedding request %d (priority %d): %s",
+                    req.rid, req.priority, why)
+        req.done = True
+        req.outcome = "shed"
+        self.robust.shed += 1
+        self._just_finished.append(req)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request by id; returns False when it is unknown or
+        already terminal. A waiting request terminates immediately; an
+        active one is reaped at the next step boundary — pages released,
+        full pages donated to the prefix cache, any speculative
+        provisioning rolled back — exactly like a finished request."""
+        for i, r in enumerate(self.waiting):
+            if r.rid == rid:
+                del self.waiting[i]
+                r.done = True
+                r.outcome = "cancelled"
+                self.robust.cancelled += 1
+                self._just_finished.append(r)
+                return True
+        for r in self.slots:
+            if r is not None and r.rid == rid and not r.done:
+                r.done = True
+                r.outcome = "cancelled"
+                self.robust.cancelled += 1
+                return True
+        return False
 
     def step(self) -> list[Request]:
         """Admit + prefill new requests, then run one decode WINDOW
@@ -480,15 +837,50 @@ class InferenceEngine:
         tune the decode window from data rather than assertion.
         """
         t0 = time.perf_counter()
+        if self._watchdog is not None and self._watchdog.armed:
+            # Refresh at step START so idle gaps between caller-driven
+            # steps never read as stalls — only time INSIDE a step does.
+            # Arming stays with the step-END heartbeat (Watchdog's
+            # first-completed-step contract): the first step's unbounded
+            # jit compile must not trip a false stall.
+            self._watchdog.heartbeat()
         self._dev_span = 0.0
         self._prefill_span = 0.0
         self._spec_step = False
-        self._admit()
-        mixed = self.chunked and any(
-            r is not None and r.prefill_pending and not r.done
-            for r in self.slots
-        )
-        decoded = self._mixed_decode() if mixed else self._decode_all()
+        self._reap_expired()
+        # Reap expired/cancelled slots BEFORE admission so their pages are
+        # already donated/free when this step's admission pass budgets.
+        self._reap()
+        mixed = False
+        try:
+            self._admit()
+            self._maybe_inject_nan()
+            mixed = self.chunked and any(
+                r is not None and r.prefill_pending and not r.done
+                for r in self.slots
+            )
+            decoded = self._mixed_decode() if mixed else self._decode_all()
+            self._consec_failed = 0
+        except (DispatchFault, MemoryError) as e:
+            # Every dispatch path failed (or the page allocator did, at
+            # grow time): the step is abandoned with engine state
+            # consistent — injected dispatch faults fire before the device
+            # call, prefill faults unwind their admissions, grow faults
+            # leave pages owned — so fail the step, not the process. A
+            # persistent fault is not transient: re-raise after
+            # max_step_faults consecutive losses.
+            if isinstance(e, MemoryError):
+                self.robust.pool_faults += 1
+            self.robust.failed_steps += 1
+            self._consec_failed += 1
+            log.error(
+                "engine step %d failed (%s); continuing (%d/%d consecutive)",
+                self.step_no, e, self._consec_failed,
+                self.icfg.max_step_faults,
+            )
+            if self._consec_failed >= self.icfg.max_step_faults:
+                raise
+            decoded = False
         total = time.perf_counter() - t0
         self.timing["device_s"] += self._dev_span
         self.timing["prefill_s"] += self._prefill_span
@@ -521,6 +913,15 @@ class InferenceEngine:
             # callback thread — the barrier orders it before the check.
             jax.effects_barrier()
             raise_if_failed()
+        if self._watchdog is not None:
+            if self._watchdog.stalled:
+                # The watchdog fired DURING this step (a wedged/slow
+                # dispatch): the step is marked stalled and counted; the
+                # process carries on, deadline expiry handles the SLO
+                # consequences at the next boundary.
+                self.robust.stalled_steps += 1
+            self._watchdog.heartbeat()
+        self.step_no += 1
         done, self._just_finished = self._just_finished, []
         return done
 
@@ -564,7 +965,16 @@ class InferenceEngine:
             self.prefix_stats = PrefixCacheStats()
         if self._spec is not None:
             out.update(self.spec_stats.as_timing())
-            self.spec_stats = SpecDecodeStats()
+            old = self.spec_stats
+            self.spec_stats = SpecDecodeStats(
+                # Disablement is engine-lifetime state, not a window
+                # counter: the reason survives the drain.
+                disabled_reason=old.disabled_reason,
+            )
+        # Robustness counters (metrics.RobustnessStats): typed request
+        # outcomes + fault episodes, always present.
+        out.update(self.robust.as_timing())
+        self.robust = RobustnessStats()
         return out
 
     def _autotune_window(self, step_total: float) -> None:
@@ -620,8 +1030,71 @@ class InferenceEngine:
         return self._pcache.clear()
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(
-            r is not None and not r.done for r in self.slots
+        return (
+            bool(self.waiting)
+            or bool(self._just_finished)
+            or any(r is not None for r in self.slots)
+        )
+
+    def drain(self) -> list[Request]:
+        """Graceful shutdown (the SIGTERM path, wired in generate.py via
+        PreemptionHandler): stop admission, shed the wait queue with typed
+        outcomes, finish every LIVE request — donating their pages to the
+        prefix cache exactly as normal completion does — and return every
+        request that terminated during the drain. Leaves the pool fully
+        accounted (assert_page_accounting)."""
+        self.draining = True
+        keep: deque[Request] = deque()
+        while self.waiting:
+            r = self.waiting.popleft()
+            if self._in_flight(r):
+                # Preempted back into the queue after running: in-flight
+                # work the drain contract finishes, not sheds.
+                keep.append(r)
+            else:
+                self._shed(r, "draining")
+        self.waiting = keep
+        drained: list[Request] = []
+        while self.has_work():
+            drained.extend(self.step())
+        self.assert_page_accounting()
+        return drained
+
+    def close(self) -> None:
+        """Stop the serving watchdog thread (idempotent)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+
+    def assert_page_accounting(self) -> None:
+        """The drain-time pool invariant (bugfix-sweep guard for the shared
+        release path): every pool page's allocator refcount equals its live
+        owner count — one per page mapped by a request plus one per
+        prefix-cache node holding it — and the free list holds exactly the
+        rest. A double-release or leak in ANY teardown path (reap, preempt,
+        expiry, cancel, quarantine, shed) trips this immediately."""
+        n = self.icfg.num_pages
+        refs = [0] * n
+        owners = [r for r in self.slots if r is not None]
+        owners += list(self.waiting) + list(self._just_finished)
+        for req in owners:
+            for p in req.pages:
+                if p is not None:
+                    refs[p] += 1
+        if self._pcache is not None:
+            for p in self._pcache.held_pages():
+                refs[p] += 1
+        actual = [self.alloc.refcount(p) for p in range(n)]
+        bad = [
+            (p, refs[p], actual[p])
+            for p in range(1, n) if refs[p] != actual[p]
+        ]
+        assert not bad, (
+            f"page refcount mismatch (page, owners, refcount): {bad[:8]}"
+        )
+        live = sum(1 for p in range(1, n) if refs[p] > 0)
+        assert self.alloc.free_pages == n - 1 - live, (
+            f"free-list size {self.alloc.free_pages} != "
+            f"{n - 1 - live} (pool {n}, live {live})"
         )
 
     def generate(
@@ -651,10 +1124,7 @@ class InferenceEngine:
         latency-to-first-yield against throughput. Requests still waiting
         for pool admission simply yield nothing until admitted.
         """
-        reqs = []
-        for p in prompts:
-            self.submit(p, max_new_tokens)
-            reqs.append(self.waiting[-1])
+        reqs = [self.submit_request(p, max_new_tokens) for p in prompts]
         emitted = [0] * len(reqs)
         pending = set(range(len(reqs)))
         while pending:
@@ -743,7 +1213,18 @@ class InferenceEngine:
         return self.alloc.free_pages + ev
 
     def _alloc_pages(self, n: int) -> list[int]:
-        """Allocate n pages, evicting LRU prefix-cache pages as needed."""
+        """Allocate n pages, evicting LRU prefix-cache pages as needed.
+
+        EVERY engine page allocation routes through here — it is the
+        injection point for FaultSpec kind="pool" (a simulated allocator
+        exhaustion), which the admit path absorbs by deferring the request
+        and the grow path by failing the step, never the process."""
+        if self._injector is not None and (
+            self._injector.take("pool", self.step_no) is not None
+        ):
+            raise MemoryError(
+                f"injected pool exhaustion (step {self.step_no})"
+            )
         short = n - self.alloc.free_pages
         if short > 0 and self._pcache is not None:
             self.prefix_stats.evicted_pages += self._pcache.evict(short)
@@ -871,7 +1352,14 @@ class InferenceEngine:
 
     def _admit(self) -> None:
         # Pass 1 (host): claim slots + pages for every admissible request,
-        # preserving arrival order (head-of-line blocking on resources).
+        # highest priority class first, arrival order within a class
+        # (with all-default priorities this IS arrival order, exactly the
+        # pre-priority behavior) and head-of-line blocking on resources.
+        # No draining gate here: while draining, submit() sheds on arrival
+        # and drain()'s entry pass sheds queued never-started requests, so
+        # anything still in the queue is in-flight work (preempted, or
+        # unwound by a fault) that MUST re-admit to finish — gating it
+        # would livelock the drain loop.
         admitted: list[tuple[Request, int]] = []
         # Headroom pages claimed by this burst's earlier admissions but not
         # yet allocated (they materialize in _grow_pages): without carrying
@@ -881,7 +1369,11 @@ class InferenceEngine:
         # just-done prefill.
         reserved = 0
         while self.waiting:
-            req = self.waiting[0]
+            idx = max(
+                range(len(self.waiting)),
+                key=lambda i: (self.waiting[i].priority, -i),
+            )
+            req = self.waiting[idx]
             slot = next(
                 (i for i, r in enumerate(self.slots) if r is None), None
             )
@@ -932,48 +1424,71 @@ class InferenceEngine:
             if self._available() - reserved < need:
                 if m_node is not None:
                     self._pcache.unlock(m_node)
-                break  # head-of-line blocking: keep arrival order
+                break  # head-of-line blocking: keep class/arrival order
             reserved += need - n_alloc
-            self.waiting.popleft()
+            del self.waiting[idx]
             req.slot = slot
             req.admit_seq = next(self._admit_seq)
             req.prefix_node = m_node
-            if full:
-                # Whole context cached (exact page multiple): no prefill
-                # at all. Copy-on-write the final matched page — the first
-                # decode step rewrites the last token's KV slot, and
-                # shared pages are immutable — then restart decode from
-                # position len-1 with the last context token in flight.
-                cow = self._alloc_pages(1)[0]
-                self.cache = self._cow(
-                    self.cache, jnp.int32(m_pages[-1]), jnp.int32(cow)
+            # Fresh pages allocate FIRST in every branch: _alloc_pages is
+            # the only fallible op (injected/real pool exhaustion), so a
+            # MemoryError here leaves nothing to unwind beyond the claim.
+            try:
+                if full:
+                    # Whole context cached (exact page multiple): no
+                    # prefill at all. Copy-on-write the final matched page
+                    # — the first decode step rewrites the last token's KV
+                    # slot, and shared pages are immutable — then restart
+                    # decode from position len-1 with the last context
+                    # token in flight.
+                    cow = self._alloc_pages(1)[0]
+                    self.cache = self._cow(
+                        self.cache, jnp.int32(m_pages[-1]), jnp.int32(cow)
+                    )
+                    for p in m_pages[:-1]:
+                        self.alloc.retain(p)
+                    req.pages = list(m_pages[:-1]) + [cow]
+                    req.n_prefix = n_match - 1
+                    req.freed_until = 0
+                    self.prefix_stats.hits += 1
+                    self.prefix_stats.cached_tokens += len(context) - 1
+                    self.prefix_stats.cow_pages += 1
+                elif n_match:
+                    fresh = self._alloc_pages(n_alloc)
+                    live = m_pages[first_live:]
+                    for p in live:
+                        self.alloc.retain(p)
+                    req.pages = [None] * first_live + list(live) + fresh
+                    req.n_prefix = n_match
+                    req.freed_until = first_live
+                    self.prefix_stats.hits += 1
+                    self.prefix_stats.cached_tokens += n_match * self.psz
+                else:
+                    req.pages = (
+                        [None] * first_live + self._alloc_pages(n_alloc)
+                    )
+                    req.n_prefix = 0
+                    req.freed_until = first_live
+                    if self._pcache is not None:
+                        self.prefix_stats.misses += 1
+            except MemoryError as e:
+                # Pool exhaustion at admit (injected, or an allocator/
+                # accounting fault): un-claim and retry next step instead
+                # of crashing the engine mid-admission.
+                self.robust.pool_faults += 1
+                log.warning(
+                    "admission of request %d hit pool exhaustion (%s); "
+                    "deferred", req.rid, e,
                 )
-                for p in m_pages[:-1]:
-                    self.alloc.retain(p)
-                req.pages = list(m_pages[:-1]) + [cow]
-                req.n_prefix = n_match - 1
-                req.freed_until = 0
-                self.prefix_stats.hits += 1
-                self.prefix_stats.cached_tokens += len(context) - 1
-                self.prefix_stats.cow_pages += 1
-            elif n_match:
-                live = m_pages[first_live:]
-                for p in live:
-                    self.alloc.retain(p)
-                req.pages = (
-                    [None] * first_live + list(live)
-                    + self._alloc_pages(n_alloc)
-                )
-                req.n_prefix = n_match
-                req.freed_until = first_live
-                self.prefix_stats.hits += 1
-                self.prefix_stats.cached_tokens += n_match * self.psz
-            else:
-                req.pages = [None] * first_live + self._alloc_pages(n_alloc)
-                req.n_prefix = 0
-                req.freed_until = first_live
-                if self._pcache is not None:
-                    self.prefix_stats.misses += 1
+                if m_node is not None:
+                    self._pcache.unlock(m_node)
+                req.prefix_node = None
+                req.slot = None
+                # Un-claim completely: admit_seq >= 0 marks in-flight work
+                # (shed/drain-exempt), and this request never ran.
+                req.admit_seq = -1
+                self.waiting.appendleft(req)
+                break
             self.slots[slot] = req
             icfg = self.icfg
             self.slot_temp[slot] = (
@@ -1032,8 +1547,21 @@ class InferenceEngine:
                 by_bucket: dict[int, list[Request]] = {}
                 for req, s_pad in admitted:
                     by_bucket.setdefault(s_pad, []).append(req)
-                for s_pad, reqs in by_bucket.items():
-                    self._prefill_bucket(reqs, s_pad)
+                items = list(by_bucket.items())
+                for bi, (s_pad, reqs) in enumerate(items):
+                    try:
+                        self._prefill_bucket(reqs, s_pad)
+                    except DispatchFault:
+                        # The faulted bucket unwound its own admissions;
+                        # the not-yet-dispatched buckets are admitted but
+                        # unprefilled — unwind them too before failing
+                        # the step.
+                        for _, later in items[bi + 1:]:
+                            for r in reversed(later):
+                                self._teardown_slot(r, 0)
+                                r.freed_until = 0
+                                self.waiting.appendleft(r)
+                        raise
 
     def _prefill_bucket(self, reqs: list[Request], s_pad: int) -> None:
         """Prefill a group of admitted requests in one dispatch; rows may
@@ -1073,15 +1601,28 @@ class InferenceEngine:
                 0 if p is None else p for p in tail_pg
             ]
         t0 = time.perf_counter()
-        logits, self.cache = self._prefill(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
-            jnp.asarray(pages),
-            jnp.asarray(pre_lens),
-            jnp.asarray(pre_pages),
-        )
+        try:
+            logits, self.cache = self._run_dispatch(
+                "prefill", "prefill",
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(pages),
+                jnp.asarray(pre_lens),
+                jnp.asarray(pre_pages),
+            )
+        except DispatchFault:
+            # Unwind this burst's admissions: their slots are claimed but
+            # NO KV was written, so tear down with nothing donated
+            # (n_cached=0 — donating would insert garbage pages into the
+            # prefix cache) and re-queue at the head for the next step's
+            # re-prefill.
+            for r in reversed(reqs):
+                self._teardown_slot(r, 0)
+                r.freed_until = 0
+                self.waiting.appendleft(r)
+            raise
         firsts = self._sample(logits, reqs)   # blocks on the device fetch
         self._prefill_span += time.perf_counter() - t0
         for i, req in enumerate(reqs):
@@ -1121,40 +1662,56 @@ class InferenceEngine:
             # request restarts adaptation cold on re-admission.
             self._spec.drop(req.rid)
 
+    def _teardown_slot(self, req: Request, n_cached: int) -> None:
+        """The ONE slot-teardown path every exit shares: reap (completion,
+        expiry, cancel), preemption and quarantine all release pages
+        (donating the first ``n_cached`` tokens' full pages to the prefix
+        cache via _release_request) and clear the slot's scheduler arrays
+        HERE, so the pool invariant (assert_page_accounting) has a single
+        code path to hold instead of three hand-rolled variants."""
+        slot = req.slot
+        self._release_request(req, n_cached)
+        req.slot = None
+        self.slots[slot] = None
+        self.page_table[slot] = 0
+        self.seq_lens[slot] = 0
+        self.last_token[slot] = 0
+
     def _preempt(self, req: Request) -> None:
         """Evict an active request, returning its pages; it re-enters at the
         head of the queue and resumes from its full context on re-prefill
         (cheaply, when the prefix cache kept its pages)."""
         log.info("preempting request %d (pool pressure)", req.rid)
         self.preemptions += 1
-        slot = req.slot
         # Mid-prefill preemption: seq_lens is the chunk cursor, so exactly
         # the completed chunks' full pages donate to the prefix cache and
         # re-admission resumes from whatever the cache kept.
-        self._release_request(req, int(self.seq_lens[slot]))
+        self._teardown_slot(req, int(self.seq_lens[req.slot]))
         req.freed_until = 0
         req.prefill_pending = False
         req.prefill_done = 0
-        req.slot = None
-        self.slots[slot] = None
-        self.page_table[slot] = 0
-        self.seq_lens[slot] = 0
-        self.last_token[slot] = 0
         self.waiting.appendleft(req)
 
     def _grow_pages(self, window: Optional[int] = None) -> None:
         """Pre-provision every active slot with pages covering the whole
         upcoming decode window (the device writes up to W positions ahead of
         the host's view, including past mid-window EOS), preempting the
-        youngest-admitted request under pool pressure (oldest requests keep
-        making progress; no mid-decode crash). ``window`` overrides the
+        lowest-priority youngest-admitted request under pool pressure
+        (high classes and older requests keep making progress; no
+        mid-decode crash). ``window`` overrides the
         span for verify steps (speculate_tokens+1 write positions per
         slot — always within _provision_window, which admission budgeted
         for)."""
         W = self.decode_window if window is None else window
+        # Provisioning rank: high priority classes first, oldest first
+        # within a class — so the preemption victim (the LAST ranked
+        # request below) is the lowest class's youngest member, honoring
+        # the submit() contract that low classes evict first. With
+        # all-default priorities this is exactly the pre-priority
+        # youngest-admitted order.
         by_age = sorted(
             (r for r in self.slots if r is not None and not r.done),
-            key=lambda r: r.admit_seq,
+            key=lambda r: (-r.priority, r.admit_seq),
         )
         for req in by_age:
             if req.slot is None:
@@ -1162,7 +1719,7 @@ class InferenceEngine:
             pos = int(self.seq_lens[req.slot])
             last = min(pos + W - 1, self.icfg.max_seq_len - 1)
             n_need = min(last // self.psz + 1, self.pages_per_seq)
-            while len(req.pages) < n_need:
+            while req.slot is not None and len(req.pages) < n_need:
                 while self.alloc.free_pages < 1:
                     # Reclaim cached pages before touching live requests:
                     # the prefix cache is headroom, not a tenant. (A
@@ -1174,14 +1731,28 @@ class InferenceEngine:
                     victims = [
                         r for r in by_age
                         if r.slot is not None and r is not req
+                        and r.priority <= req.priority
                     ]
                     if not victims:
-                        raise MemoryError(
-                            "KV pool too small for a single request; raise "
-                            "inference.num_pages"
-                        )
+                        if not any(
+                            r.slot is not None and r is not req
+                            for r in by_age
+                        ):
+                            raise MemoryError(
+                                "KV pool too small for a single request; "
+                                "raise inference.num_pages"
+                            )
+                        # Only HIGHER-priority tenants hold pages: a
+                        # low-priority request must never grow at their
+                        # expense — evict the requester itself instead.
+                        self._preempt(req)
+                        break
                     self._preempt(victims[-1])
-                page = self.alloc.alloc(1)[0]
+                if req.slot is None:
+                    break   # self-preempted above
+                # Through _alloc_pages for the pool-fault injection point
+                # (free_pages >= 1 here, so no second eviction pass runs).
+                page = self._alloc_pages(1)[0]
                 self.page_table[req.slot, len(req.pages)] = page
                 req.pages.append(page)
 
@@ -1289,17 +1860,28 @@ class InferenceEngine:
             r.temperature is None and r.top_k is None and r.top_p is None
             for r in active
         ):
-            acc, alt, self.cache = self._verify_defaults(*common)
+            out = self._run_dispatch("verify", "verify_defaults", *common)
         else:
-            acc, alt, self.cache = self._verify(
-                *common,
+            out = self._run_dispatch(
+                "verify", "verify", *common,
                 jnp.asarray(self.slot_temp),
                 jnp.asarray(self.slot_top_k),
                 jnp.asarray(self.slot_top_p),
             )
-        acc, alt = jax.device_get((acc, alt))   # ONE fetch
+        if self._guard:
+            acc, alt, ok, self.cache = out
+            acc, alt, okh = jax.device_get((acc, alt, ok))   # ONE fetch
+        else:
+            acc, alt, self.cache = out
+            acc, alt = jax.device_get((acc, alt))   # ONE fetch
+            okh = None
         self._dev_span += time.perf_counter() - t_dev
         self.timing["slot_steps"] += len(active)
+        if okh is not None:
+            for req in active:
+                if not okh[req.slot]:
+                    self._quarantine(req, "nan")
+            active = [r for r in active if r.slot is not None]
         self._accept_and_rollback(active, tokens, lens, acc, alt)
         self._reap()
         return True
@@ -1363,7 +1945,7 @@ class InferenceEngine:
 
     def _decode_all(self) -> bool:
         self._roll_window()
-        if self._spec is not None:
+        if self._spec is not None and not self._spec_disabled:
             drafts = self._propose_drafts(
                 [r for r in self.slots if r is not None and not r.done]
             )
@@ -1400,17 +1982,33 @@ class InferenceEngine:
             r.temperature is None and r.top_k is None and r.top_p is None
             for r in active
         ):
-            toks, self.cache = self._decode_defaults(*common)
+            out = self._run_dispatch("decode", "decode_defaults", *common)
         else:
-            toks, self.cache = self._decode(
-                *common,
+            out = self._run_dispatch(
+                "decode", "decode", *common,
                 jnp.asarray(self.slot_temp),
                 jnp.asarray(self.slot_top_k),
                 jnp.asarray(self.slot_top_p),
             )
-        tokens = np.asarray(jax.device_get(toks))   # [W, B], ONE fetch
+        if self._guard:
+            toks, ok, self.cache = out
+            tokens, okh = jax.device_get((toks, ok))   # ONE fetch
+            tokens = np.asarray(tokens)
+        else:
+            toks, self.cache = out
+            tokens = np.asarray(jax.device_get(toks))  # [W, B], ONE fetch
+            okh = None
         self._dev_span += time.perf_counter() - t_dev
         self.timing["slot_steps"] += W * len(active)
+        if okh is not None:
+            for req in active:
+                if not okh[req.slot]:
+                    # Non-finite logits in this slot's window: the whole
+                    # window's tokens for it are suspect — drop them all
+                    # and quarantine (neighbors' tokens are unaffected;
+                    # no slot ever reads another's pages).
+                    self._quarantine(req, "nan")
+            active = [r for r in active if r.slot is not None]
         for j in range(W):
             for req in active:
                 if req.done:
@@ -1441,7 +2039,7 @@ class InferenceEngine:
         dispatch."""
         self._roll_window()
         drafts = None
-        if self._spec is not None:
+        if self._spec is not None and not self._spec_disabled:
             drafts = self._propose_drafts([
                 r for r in self.slots
                 if r is not None and not r.done and not r.prefill_pending
@@ -1570,14 +2168,20 @@ class InferenceEngine:
             ) + chunk_args
             t_dev = time.perf_counter()
             if defaults:
-                acc, alt, p_logits, self.cache = (
-                    self._mixed_verify_defaults(*common)
+                out = self._run_dispatch(
+                    "mixed_verify", "mixed_verify_defaults", *common
                 )
             else:
-                acc, alt, p_logits, self.cache = self._mixed_verify(
-                    *common, *override_args
+                out = self._run_dispatch(
+                    "mixed_verify", "mixed_verify", *common, *override_args
                 )
-            acc, alt = jax.device_get((acc, alt))   # ONE fetch
+            if self._guard:
+                acc, alt, ok, p_logits, self.cache = out
+                acc, alt, okh = jax.device_get((acc, alt, ok))  # ONE fetch
+            else:
+                acc, alt, p_logits, self.cache = out
+                acc, alt = jax.device_get((acc, alt))   # ONE fetch
+                okh = None
             self._dev_span += time.perf_counter() - t_dev
         else:
             common = (
@@ -1591,12 +2195,19 @@ class InferenceEngine:
             ) + chunk_args
             t_dev = time.perf_counter()
             if defaults:
-                d_toks, p_logits, self.cache = self._mixed_defaults(*common)
+                out = self._run_dispatch("mixed", "mixed_defaults", *common)
             else:
-                d_toks, p_logits, self.cache = self._mixed(
-                    *common, *override_args
+                out = self._run_dispatch(
+                    "mixed", "mixed", *common, *override_args
                 )
-            d_out = np.asarray(jax.device_get(d_toks))   # [B], ONE fetch
+            if self._guard:
+                d_toks, ok, p_logits, self.cache = out
+                d_out, okh = jax.device_get((d_toks, ok))   # ONE fetch
+                d_out = np.asarray(d_out)
+            else:
+                d_toks, p_logits, self.cache = out
+                d_out = np.asarray(jax.device_get(d_toks))  # [B], ONE fetch
+                okh = None
             self._dev_span += time.perf_counter() - t_dev
         real = sum(k for _, k in chunks)
         self.timing["mixed_steps"] += 1
@@ -1632,6 +2243,14 @@ class InferenceEngine:
         # slot, then rollback (same walk as the pure verify step).
         # Otherwise W = 1, so no mid-window waste by construction.
         self.timing["slot_steps"] += len(dec)
+        if okh is not None:
+            # NaN quarantine (decode rows only — the guard rides the
+            # decode/verify half of the mixed program; prompt-phase rows
+            # are not sampled from this step).
+            for r in dec:
+                if not okh[r.slot]:
+                    self._quarantine(r, "nan")
+            dec = [r for r in dec if r.slot is not None]
         if drafts is not None:
             self._accept_and_rollback(dec, vtok, vlens, acc, alt)
         else:
@@ -1692,12 +2311,10 @@ class InferenceEngine:
     def _reap(self) -> None:
         for i, req in enumerate(self.slots):
             if req is not None and req.done:
+                if not req.outcome:
+                    req.outcome = "completed"
                 # seq_lens counts tokens whose KV is actually in the pool
                 # (decode-window overshoot lands beyond it): the full pages
                 # below it are what _release_request donates to the cache.
-                self._release_request(req, int(self.seq_lens[i]))
-                self.slots[i] = None
-                self.page_table[i] = 0
-                self.seq_lens[i] = 0
-                self.last_token[i] = 0
+                self._teardown_slot(req, int(self.seq_lens[i]))
                 self._just_finished.append(req)

@@ -198,6 +198,41 @@ def rollback_pages(
     return dead
 
 
+def poison_page(cache: Cache, page, *, n_layers: int, num_pages: int) -> Cache:
+    """Overwrite one pool page's K rows (all layers) with NaN — the fault
+    INJECTION primitive behind the NaN-quarantine tests (runtime/fault.py
+    FaultSpec kind="nan"): real NaNs flow through the real attention into
+    exactly one slot's logits, because no other slot ever reads this
+    request's pages. Under kv_quant the int8 pool cannot hold a NaN, so the
+    f32 ``k_scale`` rows are poisoned instead (dequantized K goes NaN, same
+    blast radius). ``page`` may be a traced scalar."""
+    layer_rows = jnp.arange(n_layers, dtype=jnp.int32) * num_pages + page
+    target = "k_scale" if "k_scale" in cache else "k"
+    out = dict(cache)
+    arr = out[target]
+    out[target] = arr.at[layer_rows].set(jnp.asarray(jnp.nan, arr.dtype))
+    return out
+
+
+def scrub_pages(
+    cache: Cache, pages: jax.Array, *, n_layers: int, num_pages: int
+) -> Cache:
+    """Zero the given pool pages' rows across every cache array (all
+    layers): the quarantine path scrubs a poisoned request's private pages
+    before returning them to the free list, so stale NaNs can never leak
+    into a later tenant of the same page. ``pages`` may contain repeats
+    and scratch page 0 (padding) — zeroing scratch is harmless, it is
+    never read."""
+    layer_rows = (
+        jnp.arange(n_layers, dtype=jnp.int32)[:, None] * num_pages
+        + pages[None, :].astype(jnp.int32)
+    ).reshape(-1)
+    return {
+        name: arr.at[layer_rows].set(jnp.zeros((), arr.dtype))
+        for name, arr in cache.items()
+    }
+
+
 def copy_page(cache: Cache, src, dst, *, n_layers: int, num_pages: int) -> Cache:
     """Copy one pool page's rows (all layers, all cache arrays) src -> dst.
 

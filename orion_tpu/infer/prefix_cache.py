@@ -137,6 +137,14 @@ class PrefixCache:
 
     # -- public API --------------------------------------------------------
 
+    def held_pages(self):
+        """Every pool page a cache node currently holds, one yield per
+        (node, page) reference — the public accounting surface the
+        engine's pool invariant (assert_page_accounting) sums against,
+        so refcount checks never couple to the tree's internals."""
+        for node in self._walk():
+            yield from node.pages
+
     def match(self, tokens, max_pages: int):
         """Longest cached page-granular prefix of ``tokens`` (capped at
         ``max_pages`` pages). Returns ``(pages, node)``: the shared page

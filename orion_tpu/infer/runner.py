@@ -489,7 +489,8 @@ def decode_window(
     cfg: ModelConfig,
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
-) -> tuple[jax.Array, Cache]:
+    nan_guard: bool = False,
+) -> tuple[jax.Array, ...]:
     """W fused decode+sample steps; returns (tokens [W, B] int32, cache).
 
     The engine fetches the whole [W, B] token block once per window and does
@@ -499,11 +500,19 @@ def decode_window(
     ``active`` and within the context window; frozen slots clamp their
     write position to max_seq_len - 1 (their own last slot — garbage there
     is unreachable because the host has already finished them).
+
+    With ``nan_guard`` the return is ``(tokens, ok, cache)``: ``ok`` [B]
+    bool is per-slot "every live inner step's logits were finite" — the
+    engine quarantines slots that trip it. Guard off keeps the carry and
+    trace exactly the pre-guard program.
     """
     from orion_tpu.infer.sampling import sample
 
     def stepf(carry, sub):
-        tok, sl, cc = carry
+        if nan_guard:
+            tok, sl, ok, cc = carry
+        else:
+            tok, sl, cc = carry
         act = active & (sl < max_seq_len)
         wp = jnp.minimum(sl, max_seq_len - 1)
         logits, cc = _decode_core(params, cc, tok, wp, page_table, cfg, mesh)
@@ -512,8 +521,17 @@ def decode_window(
         )
         tok = jnp.where(act, toks, tok)
         sl = sl + act.astype(sl.dtype)
+        if nan_guard:
+            ok = ok & (jnp.isfinite(logits).all(-1) | ~act)
+            return (tok, sl, ok, cc), toks
         return (tok, sl, cc), toks
 
+    if nan_guard:
+        init = (
+            tokens, seq_lens, jnp.ones_like(active, dtype=bool), dict(cache)
+        )
+        (_, _, ok, cache), toks = jax.lax.scan(stepf, init, keys)
+        return toks, ok, cache
     (_, _, cache), toks = jax.lax.scan(
         stepf, (tokens, seq_lens, dict(cache)), keys
     )
@@ -733,7 +751,8 @@ def verify_step(
     cfg: ModelConfig,
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
-) -> tuple[jax.Array, jax.Array, Cache]:
+    nan_guard: bool = False,
+) -> tuple[jax.Array, ...]:
     """Score K drafts for EVERY live slot in ONE dispatch (speculative
     decoding's verification half; drafting is infer/spec_decode.py).
 
@@ -781,6 +800,13 @@ def verify_step(
         logits, _draft_next(tokens, lens), key,
         temperature=temperature, top_k=top_k, top_p=top_p,
     )
+    if nan_guard:
+        # Per-slot finite check over the row's REAL positions only (padding
+        # positions compute on scratch-page garbage by design).
+        steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+        valid = active[:, None] & (steps < lens[:, None])
+        ok = jnp.where(valid, jnp.isfinite(logits).all(-1), True).all(-1)
+        return accept, alt, ok, cache
     return accept, alt, cache
 
 
@@ -805,7 +831,8 @@ def mixed_step(
     cfg: ModelConfig,
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
-) -> tuple[jax.Array, jax.Array, Cache]:
+    nan_guard: bool = False,
+) -> tuple[jax.Array, ...]:
     """One UNIFIED mixed prefill+decode step (inference.chunked_prefill):
     a single-token decode for every live slot fused with up to the chunk
     budget of prompt-tail tokens, in ONE dispatch.
@@ -834,7 +861,8 @@ def mixed_step(
     """
     from orion_tpu.infer.sampling import sample
 
-    del active  # host-side bookkeeping filters; kept for decode parity
+    if not nan_guard:
+        del active  # host-side bookkeeping filters; kept for decode parity
     wp = jnp.minimum(seq_lens, max_seq_len - 1)
     pctx = _prefill_ctx(
         cache, p_tokens, p_lengths, p_pages, p_prefix_lens, p_prefix_pages,
@@ -859,6 +887,9 @@ def mixed_step(
         d_logits, key, temperature=temperature, top_k=top_k, top_p=top_p
     )
     p_logits = _prefill_logits(params, xp, p_lengths, cfg)
+    if nan_guard:
+        ok = jnp.isfinite(d_logits).all(-1) | ~active
+        return toks, ok, p_logits, cache
     return toks, p_logits, cache
 
 
@@ -884,7 +915,8 @@ def mixed_verify_step(
     cfg: ModelConfig,
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
-) -> tuple[jax.Array, jax.Array, jax.Array, Cache]:
+    nan_guard: bool = False,
+) -> tuple[jax.Array, ...]:
     """``mixed_step`` with the decode half replaced by the verify body:
     speculative decoding composed with chunked prefill. One dispatch runs
     up to the chunk budget of prompt tail (prompt-phase slots — they skip
@@ -925,4 +957,9 @@ def mixed_verify_step(
         temperature=temperature, top_k=top_k, top_p=top_p,
     )
     p_logits = _prefill_logits(params, xp, p_lengths, cfg)
+    if nan_guard:
+        steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+        valid = active[:, None] & (steps < lens[:, None])
+        ok = jnp.where(valid, jnp.isfinite(logits).all(-1), True).all(-1)
+        return accept, alt, ok, p_logits, cache
     return accept, alt, p_logits, cache

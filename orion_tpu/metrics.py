@@ -131,6 +131,11 @@ class SpecDecodeStats:
     verify_steps: int = 0
     verify_slot_steps: int = 0
     gated_steps: int = 0
+    # Why the engine auto-disabled speculation (degradation ladder: repeated
+    # verify-path dispatch faults), or None while speculation is live.
+    # Carried across reset_timing drains — disablement is engine-lifetime
+    # state, not a per-window counter.
+    disabled_reason: Optional[str] = None
 
     @property
     def acceptance_rate(self) -> float:
@@ -154,6 +159,51 @@ class SpecDecodeStats:
             "verify_slot_steps": self.verify_slot_steps,
             "spec_tokens_per_verify": self.tokens_per_verify,
             "spec_gated_steps": self.gated_steps,
+            "spec_disabled_reason": self.disabled_reason or "",
+        }
+
+
+@dataclass
+class RobustnessStats:
+    """Fault-tolerance counters (ISSUE 6), owned by InferenceEngine and
+    drained through ``reset_timing`` like the cache/speculation stats.
+
+    Request outcomes: ``shed`` (bounded-queue overload or drain — never
+    admitted), ``expired`` (deadline passed; reaped at a step boundary),
+    ``cancelled`` (cancel(rid)), ``quarantined`` (non-finite logits; the
+    request errored, neighbors unaffected). Every terminal request carries
+    exactly one typed outcome — there are no silent drops.
+
+    Fault episodes: ``dispatch_faults`` counts dispatch attempts that
+    raised (injected or real), ``dispatch_fallbacks`` the retries that ran
+    on the XLA reference path, ``failed_steps`` engine steps abandoned
+    after every path failed (the engine continues; state untouched),
+    ``stalled_steps`` steps the watchdog flagged as stalled, and
+    ``pool_faults`` page-allocation failures absorbed at admit/grow.
+    """
+
+    shed: int = 0
+    expired: int = 0
+    cancelled: int = 0
+    quarantined: int = 0
+    dispatch_faults: int = 0
+    dispatch_fallbacks: int = 0
+    failed_steps: int = 0
+    stalled_steps: int = 0
+    pool_faults: int = 0
+
+    def as_timing(self) -> dict[str, float]:
+        """Flatten into the engine's reset_timing dict."""
+        return {
+            "shed_requests": self.shed,
+            "expired_requests": self.expired,
+            "cancelled_requests": self.cancelled,
+            "quarantined_requests": self.quarantined,
+            "dispatch_faults": self.dispatch_faults,
+            "dispatch_fallbacks": self.dispatch_fallbacks,
+            "failed_steps": self.failed_steps,
+            "stalled_steps": self.stalled_steps,
+            "pool_faults": self.pool_faults,
         }
 
 

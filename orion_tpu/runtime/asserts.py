@@ -14,7 +14,7 @@ the call is a Python no-op — nothing enters the jaxpr, so production
 programs are unchanged.
 
 ``inject(site)`` force-fails a named assert site (test hook, mirroring
-train/fault.py's fault-injection style): it validates that an assert is
+runtime/fault.py's fault-injection style): it validates that an assert is
 actually wired into a given layout's compiled program, complementing the
 true-corruption tests that monkeypatch router outputs.
 """

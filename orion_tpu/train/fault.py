@@ -1,207 +1,12 @@
-"""Failure detection and elastic recovery (SURVEY.md §6 "Failure detection /
-elastic recovery / fault injection").
+"""Compatibility shim: the fault-tolerance machinery moved to
+``orion_tpu.runtime.fault`` so the serving stack can share it (preemption
+drains, the stall watchdog, fault injection). Import from there."""
 
-TPU-native mapping of the reference's torchelastic-class machinery:
+from orion_tpu.runtime.fault import (  # noqa: F401
+    Preempted,
+    PreemptionHandler,
+    Watchdog,
+    run_with_restarts,
+)
 
-  - ``PreemptionHandler`` — TPU pods are preempted with SIGTERM; the handler
-    flips a flag that the trainer checks at the step boundary, saves a final
-    checkpoint and exits cleanly so the supervisor restart resumes losslessly.
-  - ``run_with_restarts`` — the in-process supervisor loop: rebuild the
-    trainer and resume from the latest checkpoint after a recoverable
-    failure (the cross-process equivalent is just re-running train.py, since
-    restore_or_init is the first thing the trainer does).
-  - ``Watchdog`` — step-progress heartbeat; a hung collective (the
-    multi-host failure mode NCCL surfaces as a timeout) trips the callback
-    after ``timeout_s`` without a heartbeat.
-
-Fault *injection* lives in the trainer (train.inject_fault_at_step), closing
-the loop: tests crash a real run and assert recovery.
-"""
-
-from __future__ import annotations
-
-import logging
-import signal
-import threading
-import time
-from typing import Callable, Optional, Sequence, Type
-
-log = logging.getLogger("orion_tpu.fault")
-
-
-class Preempted(RuntimeError):
-    """Raised by the trainer after a preemption-triggered final save."""
-
-
-class PreemptionHandler:
-    """Installs SIGTERM/SIGINT-compatible preemption flagging.
-
-    Usage: ``with PreemptionHandler() as h: ... if h.preempted: save+exit``.
-    Signal delivery only sets a flag — all real work (checkpoint save)
-    happens synchronously at the trainer's step boundary, where the train
-    state is consistent.
-    """
-
-    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
-        self.signals = tuple(signals)
-        self._flag = threading.Event()
-        self._prev: dict[int, object] = {}
-
-    @property
-    def preempted(self) -> bool:
-        return self._flag.is_set()
-
-    def _on_signal(self, signum, frame):
-        log.warning("received signal %d: preemption flagged", signum)
-        self._flag.set()
-
-    def __enter__(self) -> "PreemptionHandler":
-        for s in self.signals:
-            try:
-                self._prev[s] = signal.signal(s, self._on_signal)
-            except ValueError:
-                # Not the main thread (e.g. under some test runners): fall
-                # back to manual .trigger() only.
-                log.debug("cannot install handler for signal %d", s)
-        return self
-
-    def trigger(self) -> None:
-        """Manually flag preemption (tests / external schedulers)."""
-        self._flag.set()
-
-    def __exit__(self, *exc) -> None:
-        for s, prev in self._prev.items():
-            signal.signal(s, prev)
-        self._prev.clear()
-
-
-def run_with_restarts(
-    make_and_fit: Callable[[int], object],
-    *,
-    max_restarts: int = 3,
-    retry_on: tuple[Type[BaseException], ...] = (Exception,),
-    non_retryable: tuple[Type[BaseException], ...] = (ValueError, TypeError),
-    backoff_s: float = 0.0,
-) -> object:
-    """Supervisor loop: call ``make_and_fit(attempt)``, restarting on failure.
-
-    ``make_and_fit`` must rebuild its world from scratch (config -> Trainer
-    -> restore_or_init -> fit) so every attempt resumes from the newest
-    checkpoint. KeyboardInterrupt and Preempted always propagate — those are
-    orderly shutdowns, not failures — as do ``non_retryable`` types
-    (config/typo errors are deterministic; retrying them wastes compute).
-    """
-    attempt = 0
-    while True:
-        try:
-            return make_and_fit(attempt)
-        except (KeyboardInterrupt, Preempted):
-            raise
-        except non_retryable:
-            raise
-        except retry_on as e:
-            attempt += 1
-            if attempt > max_restarts:
-                log.error("giving up after %d restarts", max_restarts)
-                raise
-            log.warning(
-                "attempt %d failed (%s: %s); restarting (%d/%d)",
-                attempt - 1, type(e).__name__, e, attempt, max_restarts,
-            )
-            if backoff_s:
-                time.sleep(backoff_s)
-
-
-class Watchdog:
-    """Detects stalled training (hung collective / dead host).
-
-    The trainer calls ``heartbeat()`` once per completed step; once armed,
-    if no heartbeat arrives within ``timeout_s``, ``on_stall`` fires
-    (default: log loudly). The watchdog ARMS AT THE FIRST HEARTBEAT — the
-    first step's jit compile is unbounded and must not trip a false "hung
-    collective" alarm. The monitor is a daemon thread and never blocks
-    training. ``timeout_s=None`` constructs a disabled no-op watchdog.
-    """
-
-    def __init__(
-        self,
-        timeout_s: Optional[float],
-        on_stall: Optional[Callable[[float], None]] = None,
-        poll_s: Optional[float] = None,
-        action: str = "log",
-    ):
-        if action not in ("log", "abort"):
-            raise ValueError(f"unknown watchdog action {action!r}")
-        self.timeout_s = timeout_s
-        if on_stall is not None:
-            self.on_stall = on_stall
-        elif action == "abort":
-            self.on_stall = self._abort_on_stall
-        else:
-            self.on_stall = self._default_on_stall
-        self._poll_s = (
-            poll_s if poll_s is not None
-            else min((timeout_s or 40.0) / 4, 10.0)
-        )
-        self._last: Optional[float] = None   # None until armed
-        self._stop = threading.Event()
-        self._fired = False
-        self._thread: Optional[threading.Thread] = None
-
-    @staticmethod
-    def _default_on_stall(elapsed: float) -> None:
-        log.error(
-            "watchdog: no step completed for %.1fs — suspect hung "
-            "collective or dead peer host", elapsed,
-        )
-
-    @staticmethod
-    def _abort_on_stall(elapsed: float) -> None:
-        """Kill the process so the (cross-process) supervisor restarts it.
-
-        A hung collective cannot be recovered in-process — the device queue
-        is wedged — so detection must feed the restart loop: SIGABRT takes
-        the whole process down and the supervisor (re-run of train.py, or
-        an external scheduler) resumes from the latest checkpoint.
-        """
-        import os
-
-        log.error(
-            "watchdog: no step completed for %.1fs — aborting for "
-            "supervisor restart (hung collective / dead peer host)", elapsed,
-        )
-        os.kill(os.getpid(), signal.SIGABRT)
-
-    def heartbeat(self) -> None:
-        self._last = time.monotonic()
-        self._fired = False
-
-    @property
-    def stalled(self) -> bool:
-        return self._fired
-
-    def _run(self) -> None:
-        while not self._stop.wait(self._poll_s):
-            if self._last is None:
-                continue  # not armed: first step still compiling
-            elapsed = time.monotonic() - self._last
-            if elapsed > self.timeout_s and not self._fired:
-                self._fired = True
-                try:
-                    self.on_stall(elapsed)
-                except Exception:
-                    log.exception("watchdog on_stall callback failed")
-
-    def __enter__(self) -> "Watchdog":
-        if self.timeout_s is None:
-            return self
-        self._thread = threading.Thread(
-            target=self._run, name="orion-watchdog", daemon=True
-        )
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+__all__ = ["Preempted", "PreemptionHandler", "Watchdog", "run_with_restarts"]

@@ -682,7 +682,7 @@ class Trainer:
         state: Optional[TrainState] = None,
         preemption_handler: Optional[Any] = None,
     ) -> list:
-        from orion_tpu.train.fault import Preempted, PreemptionHandler, Watchdog
+        from orion_tpu.runtime.fault import Preempted, PreemptionHandler, Watchdog
         import contextlib
 
         cfg = self.cfg

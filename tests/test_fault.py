@@ -1,5 +1,18 @@
-"""Fault-tier tests (SURVEY.md §5): preemption -> clean save -> lossless
-resume; supervisor restarts; stall watchdog."""
+"""Fault-tier tests (SURVEY.md §5-6) for BOTH stacks sharing
+orion_tpu/runtime/fault.py:
+
+  - training: preemption -> clean save -> lossless resume; supervisor
+    restarts; stall watchdog (the original tier, Trainer-heavy cases
+    marked slow per the tier-1 budget convention);
+  - serving (ISSUE 6): deadlines/cancellation, bounded-queue shedding,
+    fault injection (dispatch, pool, NaN, stall) and the graceful-
+    degradation ladder — every episode ends with the engine completing
+    the remaining requests byte-identically to a fault-free run, and the
+    page pool exactly accounted (assert_page_accounting).
+
+Fast engine cases run in tier-1; heavy kernel/feature compositions
+(pallas x int8 x SWA x chunked x fault) are `slow`.
+"""
 
 import os
 import signal
@@ -10,21 +23,26 @@ import numpy as np
 import pytest
 
 from orion_tpu.config import get_config
-from orion_tpu.train import Trainer
-from orion_tpu.train.fault import (
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.models import init_params
+from orion_tpu.runtime.fault import (
+    DispatchFault,
+    FaultInjector,
+    FaultSpec,
     Preempted,
     PreemptionHandler,
     Watchdog,
     run_with_restarts,
 )
+from orion_tpu.train import Trainer
 from orion_tpu.train.trainer import FaultInjected
 
-# Revived on jax-0.4.37 boxes by the round-6 compat shims (previously a
-# collection error), but too heavy for the tier-1 CPU budget — the serving
-# stack (test_infer / test_prefix_cache) owns that budget this round. Runs
-# in the full tier (no `-m "not slow"`).
-pytestmark = pytest.mark.slow
+slow = pytest.mark.slow
 
+
+# ---------------------------------------------------------------------------
+# Training stack (the original fault tier)
+# ---------------------------------------------------------------------------
 
 
 def _cfg(tmp_path=None, extra=()):
@@ -40,6 +58,7 @@ def _cfg(tmp_path=None, extra=()):
     return get_config("tiny", list(overrides) + list(extra))
 
 
+@slow
 def test_preemption_mid_run_saves_and_resumes(tmp_path):
     """Preemption mid-run -> checkpoint at the interrupted step -> resume
     reproduces the uninterrupted loss trajectory."""
@@ -90,6 +109,22 @@ def test_preemption_handler_catches_sigterm():
     assert signal.getsignal(signal.SIGTERM) != h._on_signal
 
 
+def test_preemption_handler_double_enter_restores_original():
+    """Regression (ISSUE 6 Watchdog/handler hardening): a nested
+    __enter__ must keep the ORIGINAL prior disposition — recording its
+    own handler as "prior" would make __exit__ leave the process wired
+    to a dead handler object."""
+    prev = signal.getsignal(signal.SIGTERM)
+    h = PreemptionHandler()
+    with h:
+        installed = signal.getsignal(signal.SIGTERM)
+        h.__enter__()    # double-enter: must not re-record "prior"
+        assert signal.getsignal(signal.SIGTERM) == installed
+        assert h._prev[signal.SIGTERM] == prev
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+@slow
 def test_run_with_restarts_resumes_after_fault(tmp_path):
     """The supervisor loop retries a crashed run; the retry resumes from the
     crash checkpoint rather than step 0."""
@@ -136,13 +171,13 @@ def test_watchdog_detects_stall_and_recovers():
         assert not wd.stalled
         time.sleep(0.1)
         assert len(fired) == 1      # no re-fire while fresh
+    assert not wd.running
 
 
 def test_watchdog_abort_action_signals_process(monkeypatch):
     """action='abort' closes the recovery loop: on stall the watchdog
     SIGABRTs the process so the supervisor restart resumes from the
     checkpoint (a hung collective is unrecoverable in-process)."""
-    import os
     import signal as _signal
 
     kills = []
@@ -157,6 +192,30 @@ def test_watchdog_abort_action_signals_process(monkeypatch):
 def test_watchdog_rejects_unknown_action():
     with pytest.raises(ValueError, match="action"):
         Watchdog(timeout_s=1.0, action="explode")
+
+
+def test_watchdog_idempotent_daemon_lifecycle():
+    """Regression (ISSUE 6 hardening): start() twice spawns ONE daemon
+    thread, stop() twice is a no-op, and a stopped watchdog restarts —
+    the serving engine owns one across many step() calls with no `with`
+    scope, so the explicit lifecycle must be safe to drive redundantly."""
+    wd = Watchdog(timeout_s=30.0, poll_s=0.05)
+    assert not wd.running and not wd.armed
+    wd.start()
+    t1 = wd._thread
+    assert wd.running and t1.daemon
+    wd.start()                       # idempotent: same thread
+    assert wd._thread is t1
+    wd.stop()
+    assert not wd.running
+    wd.stop()                        # idempotent
+    wd.start()                       # restartable
+    assert wd.running and wd._thread is not t1
+    wd.stop()
+    # disabled watchdog: start is a no-op
+    off = Watchdog(timeout_s=None).start()
+    assert not off.running
+    off.stop()
 
 
 def test_run_with_restarts_config_errors_not_retried():
@@ -180,9 +239,620 @@ def test_watchdog_quiet_under_heartbeats():
     assert not fired and not wd.stalled
 
 
+@slow
 def test_trainer_watchdog_wired(tmp_path, caplog):
     """train.watchdog_timeout_s installs the watchdog around the fit loop
     (quiet for a healthy run)."""
     cfg = _cfg(extra=("train.num_steps=10", "train.watchdog_timeout_s=30",))
     hist = Trainer(cfg).fit()
     assert len(hist) == 10
+
+
+# ---------------------------------------------------------------------------
+# Serving stack (ISSUE 6): engine fault injection + degradation ladder
+# ---------------------------------------------------------------------------
+
+INFER = [
+    "inference.max_seq_len=128",
+    "inference.page_size=16",
+    "inference.num_pages=32",
+    "inference.max_batch_size=4",
+    "inference.prefill_chunk=16",
+    "inference.max_new_tokens=8",
+    "inference.decode_window=1",
+]
+# Cyclic prompt -> looping greedy continuation on the seed-0 tiny model,
+# so the n-gram proposer drafts (same workload as test_spec_decode).
+REP = [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8]
+MIX = [REP, [5, 3, 9, 250, 17], [7, 7, 7]]
+SPEC = ["inference.speculative=true", "inference.speculate_tokens=4"]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """(params, fault-free greedy reference outputs for MIX)."""
+    cfg = get_config("tiny-llama", INFER)
+    params = init_params(cfg.model, jax.random.key(0))
+    ref = InferenceEngine(cfg, params).generate(MIX, 8)
+    return params, ref
+
+
+def _engine(params, extra=(), inj=None):
+    cfg = get_config("tiny-llama", INFER + list(extra))
+    return InferenceEngine(cfg, params, fault_injector=inj)
+
+
+def _drain_outcomes(eng):
+    done = {}
+    while eng.has_work():
+        for r in eng.step():
+            done[r.rid] = r
+    return done
+
+
+def test_injected_dispatch_fault_contained(tiny):
+    """xla path (no fallback rung): an injected decode-dispatch fault
+    fails the STEP — counted, state untouched — and the engine completes
+    every request byte-identically to the fault-free run."""
+    params, ref = tiny
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="decode")])
+    eng = _engine(params, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["failed_steps"] == 1 and t["dispatch_faults"] == 1
+    assert inj.fired == [("dispatch", 2, "decode")]
+    eng.assert_page_accounting()
+
+
+def test_injected_prefill_fault_unwinds_admission(tiny):
+    """A prefill-dispatch fault unwinds the burst's admissions (slots and
+    pages released, NOTHING donated — no KV was written) and the requeued
+    requests re-prefill next step, byte-identically."""
+    params, ref = tiny
+    inj = FaultInjector([FaultSpec("dispatch", step=0, path="prefill")])
+    eng = _engine(params, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["failed_steps"] == 1
+    eng.assert_page_accounting()
+
+
+def test_dispatch_fallback_xla_reference(tiny):
+    """Degradation ladder rung 1: with kernels=pallas a failed dispatch
+    retries once on the XLA reference path — same step, no failed step,
+    byte-identical output."""
+    params, _ = tiny
+    pall = ["model.kernels=pallas_interpret"]
+    ref = _engine(params, pall).generate(MIX, 8)
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="decode")])
+    eng = _engine(params, pall, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["dispatch_fallbacks"] == 1 and t["failed_steps"] == 0
+    eng.assert_page_accounting()
+
+
+@slow   # tier-1 budget, round 11: knob variant of the fallback path;
+#         the fallback-on rung is tier-1 (test_dispatch_fallback_xla_reference)
+def test_dispatch_fallback_disabled_fails_step(tiny):
+    """inference.dispatch_fallback=false turns the same episode into a
+    contained failed step instead of a fallback."""
+    params, _ = tiny
+    pall = ["model.kernels=pallas_interpret"]
+    ref = _engine(params, pall).generate(MIX, 8)
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="decode")])
+    eng = _engine(
+        params, pall + ["inference.dispatch_fallback=false"], inj=inj
+    )
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["dispatch_fallbacks"] == 0 and t["failed_steps"] == 1
+
+
+def test_persistent_fault_reraises(tiny):
+    """max_step_faults consecutive failed steps is no longer transient:
+    the engine re-raises instead of spinning forever."""
+    params, _ = tiny
+    inj = FaultInjector(
+        [FaultSpec("dispatch", step=s, count=10) for s in range(20)]
+    )
+    eng = _engine(params, ["inference.max_step_faults=2"], inj=inj)
+    for p in MIX:
+        eng.submit(p, 8)
+    with pytest.raises(DispatchFault):
+        while eng.has_work():
+            eng.step()
+    t = eng.reset_timing()
+    assert t["failed_steps"] == 2
+
+
+def test_pool_fault_at_admit_defers(tiny):
+    """Injected page-pool exhaustion during admission defers the request
+    (un-claimed, still queued) instead of crashing; output exact."""
+    params, ref = tiny
+    inj = FaultInjector([FaultSpec("pool", step=0)])
+    eng = _engine(params, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["pool_faults"] == 1 and inj.fired
+    eng.assert_page_accounting()
+
+
+def test_pool_fault_at_grow_fails_step(tiny):
+    """Injected exhaustion at decode-window page growth fails the step
+    (pages stay owned, state consistent) and the retry completes."""
+    params, ref = tiny
+    # REP is 11 tokens; growth allocates when the write position crosses
+    # into page 2 at seq_len 16 — engine step 5 (prefill step emits token
+    # 1, each decode step one more).
+    inj = FaultInjector([FaultSpec("pool", step=5)])
+    eng = _engine(params, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert inj.fired == [("pool", 5, None)]
+    assert t["pool_faults"] == 1 and t["failed_steps"] == 1
+    eng.assert_page_accounting()
+
+
+def test_nan_quarantine_neighbors_exact(tiny):
+    """A NaN-poisoned slot is quarantined: that request errors with a
+    typed outcome, its pages are scrubbed and released with NO prefix
+    donation, and every neighbor's output is byte-identical to the
+    fault-free run. Guard ON with no fault stays byte-identical too."""
+    params, ref = tiny
+    guard = ["inference.nan_guard=true"]
+    assert _engine(params, guard).generate(MIX, 8) == ref
+
+    inj = FaultInjector([FaultSpec("nan", step=2)])
+    eng = _engine(params, guard, inj=inj)
+    rids = [eng.submit(p, 8) for p in MIX]
+    done = _drain_outcomes(eng)
+    t = eng.reset_timing()
+    assert t["quarantined_requests"] == 1
+    victims = [r for r in rids if done[r].outcome == "error:nan"]
+    assert len(victims) == 1
+    for i, rid in enumerate(rids):
+        if rid not in victims:
+            assert done[rid].outcome == "completed"
+            assert done[rid].generated == ref[i]
+    eng.assert_page_accounting()
+
+
+@slow   # tier-1 budget, round 11: documentation-grade variant; the
+#         guard-on quarantine path is tier-1 (test_nan_quarantine_neighbors_exact)
+def test_nan_without_guard_documented_passthrough(tiny):
+    """Guard OFF: the injected NaN flows into that slot's sampled tokens
+    (garbage-in) but the ENGINE survives, completes, and accounts pages —
+    the knob only buys detection, never stability."""
+    params, ref = tiny
+    inj = FaultInjector([FaultSpec("nan", step=2)])
+    eng = _engine(params, inj=inj)
+    out = eng.generate(MIX, 8)
+    assert [len(o) for o in out] == [len(o) for o in ref]
+    t = eng.reset_timing()
+    assert t["quarantined_requests"] == 0
+    eng.assert_page_accounting()
+
+
+def test_deadline_expiry_mid_decode_and_waiting(tiny):
+    """Deadlines lapse on an ACTIVE request mid-decode and on one still
+    WAITING in the queue: both reap at the next step boundary — typed
+    "expired", partial tokens kept for the active one, pages donated/
+    released exactly as preemption does — and the surviving neighbor
+    completes byte-identically."""
+    params, ref = tiny
+    eng = _engine(params, ["inference.max_batch_size=1"])
+    r_dead = eng.submit_request(REP, 120, deadline_s=0.25)   # admits
+    r_wait = eng.submit_request([5, 5, 5], 8, deadline_s=0.05)
+    r_live = eng.submit_request(MIX[1], 8)
+    eng.step()                      # admit r_dead + first tokens
+    assert len(r_dead.generated) >= 1
+    time.sleep(0.3)                 # both deadlines lapse
+    done = _drain_outcomes(eng)
+    assert done[r_dead.rid].outcome == "expired"
+    assert 0 < len(r_dead.generated) < 120
+    assert done[r_wait.rid].outcome == "expired"
+    assert r_wait.generated == []   # expired before ever admitted
+    assert done[r_live.rid].outcome == "completed"
+    assert r_live.generated == ref[1]
+    t = eng.reset_timing()
+    assert t["expired_requests"] == 2
+    eng.assert_page_accounting()
+
+
+@slow   # tier-1 budget, round 11: chunked engine compile; the active-
+#         and waiting-expiry paths stay tier-1 in the test above
+def test_deadline_expiry_mid_prefill(tiny):
+    """Expiry hits a chunked request still in its prompt phase: it ends
+    "expired" at a step boundary with completed chunks' pages released;
+    the live neighbor completes byte-identically."""
+    params, ref = tiny
+    chunked = [
+        "inference.chunked_prefill=true",
+        "inference.prefill_chunk_tokens=16",
+    ]
+    cref = _engine(params, chunked).generate(MIX, 8)
+    assert cref == ref              # chunked equivalence (pinned upstream)
+
+    eng = _engine(params, chunked + ["inference.max_batch_size=1"])
+    r_live = eng.submit_request(MIX[1], 8)
+    # 90-token prompt = 6 chunks; deadline lapses after the first one.
+    r_pre = eng.submit_request(list(range(1, 91)), 8, deadline_s=0.2)
+    eng.step()
+    time.sleep(0.25)
+    done = _drain_outcomes(eng)
+    assert done[r_pre.rid].outcome == "expired"
+    assert r_pre.generated == []    # never left the prompt phase
+    assert done[r_live.rid].outcome == "completed"
+    assert r_live.generated == ref[1]
+    t = eng.reset_timing()
+    assert t["expired_requests"] == 1
+    eng.assert_page_accounting()
+
+
+def test_cancel_waiting_and_speculating_slot(tiny):
+    """cancel(): a waiting request dies immediately; an ACTIVE one — mid
+    speculation, with drafted KV provisioned past its cursor — is reaped
+    at the next boundary with the rollback footprint exact (free list
+    back to full once all requests leave; double-release would trip the
+    accounting assert)."""
+    params, ref = tiny
+    eng = _engine(params, SPEC + ["inference.max_batch_size=2"])
+    r_spec = eng.submit_request(REP, 24)
+    r_wait = eng.submit_request([5, 5, 5], 8)
+    eng.step()
+    eng.step()                      # speculation in flight on REP
+    assert eng.cancel(r_wait.rid) and r_wait.outcome == "cancelled"
+    assert eng.cancel(r_spec.rid)
+    done = _drain_outcomes(eng)
+    assert done[r_spec.rid].outcome == "cancelled"
+    assert not eng.cancel(r_spec.rid)       # already terminal
+    assert not eng.cancel(10_000)           # unknown rid
+    t = eng.reset_timing()
+    assert t["cancelled_requests"] == 2
+    eng.assert_page_accounting()
+    assert eng.alloc.free_pages == eng.icfg.num_pages - 1
+
+
+def test_queue_limit_sheds_lowest_priority(tiny):
+    """Bounded admission queue: an over-limit submit sheds the lowest-
+    priority / nearest-deadline / newest candidate — possibly the
+    incoming request itself — with a typed outcome; accepted requests
+    complete untouched."""
+    params, _ = tiny
+    eng = _engine(
+        params, ["inference.queue_limit=2", "inference.max_batch_size=1"]
+    )
+    a = eng.submit_request([1, 2, 3], 8, priority=2)
+    eng.step()                      # a holds the only slot
+    lo = eng.submit_request([4, 5], 8, priority=0)
+    hi = eng.submit_request([6, 7], 8, priority=1)
+    hi2 = eng.submit_request([8, 9], 8, priority=1)   # full -> shed lo
+    assert lo.outcome == "shed" and not hi.done and not hi2.done
+    lo2 = eng.submit_request([1, 1], 8, priority=0)   # itself the victim
+    assert lo2.outcome == "shed"
+    done = _drain_outcomes(eng)
+    assert {done[r.rid].outcome for r in (a, hi, hi2)} == {"completed"}
+    # shed requests surface exactly once, through step(), like any other
+    assert done[lo.rid].outcome == "shed"
+    t = eng.reset_timing()
+    assert t["shed_requests"] == 2
+    eng.assert_page_accounting()
+
+
+def test_priority_admission_order(tiny):
+    """With one slot, a higher-priority arrival admits ahead of earlier
+    lower-priority waiters; default-priority traffic keeps pure arrival
+    order (the pre-robustness behavior)."""
+    params, _ = tiny
+    eng = _engine(params, ["inference.max_batch_size=1"])
+    a = eng.submit_request([1, 2], 4)
+    eng.step()
+    lo = eng.submit_request([3, 4], 4, priority=0)
+    hi = eng.submit_request([5, 6], 4, priority=5)
+    while not a.done:
+        eng.step()
+    while not hi.done:
+        eng.step()
+    assert hi.outcome == "completed"
+    assert not lo.done              # hi jumped the queue
+    _drain_outcomes(eng)
+    assert lo.outcome == "completed"
+
+
+def test_drain_sheds_queue_finishes_live(tiny):
+    """drain() (the SIGTERM path): admission stops, the wait queue sheds
+    with typed outcomes, live requests FINISH (pages donated as normal
+    completion), pool fully accounted; post-drain submits shed."""
+    params, ref = tiny
+    eng = _engine(params)
+    live = eng.submit_request(REP, 8)
+    eng.step()
+    waiters = [eng.submit_request([9, 9, 9], 8) for _ in range(6)]
+    eng.drain()
+    assert live.outcome == "completed" and live.generated == ref[0]
+    outs = {r.outcome for r in waiters}
+    assert outs <= {"completed", "shed"} and "shed" in outs
+    post = eng.submit_request([1, 2], 4)
+    assert post.outcome == "shed"
+    t = eng.reset_timing()
+    assert t["shed_requests"] >= 1
+
+
+def test_drain_finishes_preempted_requests(tiny):
+    """Regression (review): a request PREEMPTED mid-drain re-enters the
+    waiting queue — drain must re-admit and finish it (it is in-flight
+    work), not spin forever on an admission gate. Also: queue-pressure
+    shedding never victimizes a preempted request (it carries generated
+    tokens; "shed" means never admitted)."""
+    params, ref = tiny
+    eng = _engine(params, ["inference.queue_limit=1"])
+    a = eng.submit_request(REP, 8)
+    eng.step()                       # admit a (queue empties)
+    b = eng.submit_request(MIX[1], 8)
+    eng.step()                       # admit b
+    assert a.generated and b.generated
+    eng._preempt(b)                  # simulate pool pressure
+    # b (admitted once, priority 0) is in the queue; an over-limit burst
+    # must shed around it, never it.
+    c = eng.submit_request([9, 9], 8, priority=0)
+    assert c.outcome == "shed" and b.outcome == ""
+    drained = eng.drain()
+    assert b in drained and b.outcome == "completed"
+    assert b.generated == ref[1]     # resume-after-preempt exactness
+    assert a.outcome == "completed" and a.generated == ref[0]
+    eng.assert_page_accounting()
+
+
+def test_spec_fault_auto_disable(tiny):
+    """Degradation ladder rung 2: repeated verify-path dispatch faults
+    auto-disable speculation (SpecDecodeStats.disabled_reason, carried
+    across reset_timing) and decoding continues exactly on the plain
+    window."""
+    params, ref = tiny
+    sref = _engine(params, SPEC).generate(MIX, 8)
+    assert sref == ref              # spec greedy equivalence (upstream)
+    inj = FaultInjector(
+        [FaultSpec("dispatch", step=s, path="verify") for s in range(16)]
+    )
+    eng = _engine(params, SPEC + ["inference.spec_fault_limit=2"], inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    assert eng._spec_disabled
+    t = eng.reset_timing()
+    assert "auto-disabled" in t["spec_disabled_reason"]
+    assert len(inj.fired) == 2      # disabled: no third verify attempted
+    # the reason survives the drain (engine-lifetime state)
+    assert "auto-disabled" in eng.reset_timing()["spec_disabled_reason"]
+    eng.assert_page_accounting()
+
+
+def test_spec_fault_disable_counts_primary_faults_under_fallback(tiny):
+    """Regression (review): rung 2 must count PRIMARY verify faults even
+    when every episode is absorbed by a successful XLA fallback —
+    otherwise a persistently broken verify kernel pays a doomed primary
+    attempt + fallback forever and spec_fault_limit is a dead knob."""
+    params, ref = tiny
+    pall = SPEC + [
+        "model.kernels=pallas_interpret", "inference.spec_fault_limit=1",
+    ]
+    inj = FaultInjector(
+        [FaultSpec("dispatch", step=s, path="verify") for s in range(16)]
+    )
+    eng = _engine(params, pall, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    assert eng._spec_disabled
+    t = eng.reset_timing()
+    assert "auto-disabled" in t["spec_disabled_reason"]
+    assert t["failed_steps"] == 0       # every fault was absorbed
+    assert t["dispatch_fallbacks"] == 1
+    eng.assert_page_accounting()
+
+
+def test_preemption_prefers_low_priority_victims(tiny):
+    """Regression (review): page-pressure preemption evicts the LOWEST
+    priority class first (the submit() contract), not simply the
+    youngest admission."""
+    params, _ = tiny
+    eng = _engine(params, ["inference.max_batch_size=2"])
+    lo = eng.submit_request(REP, 24, priority=0)
+    eng.step()
+    hi = eng.submit_request(MIX[1], 24, priority=5)
+    eng.step()
+    assert lo.slot is not None and hi.slot is not None
+    # Starve the pool so the next window growth must preempt someone:
+    # hi is YOUNGER, but lo must be the victim.
+    hostage = eng.alloc.alloc(eng.alloc.free_pages)
+    for _ in range(20):
+        if lo.slot is None or hi.slot is None or not eng.has_work():
+            break
+        eng.step()
+    assert hi.slot is not None, "high-priority request was preempted"
+    assert lo.slot is None and not lo.done   # lo evicted, re-queued
+    eng.alloc.free(hostage)
+    done = _drain_outcomes(eng)
+    assert done[hi.rid].outcome == "completed"
+    assert done[lo.rid].outcome == "completed"   # resumed after pressure
+    eng.assert_page_accounting()
+
+
+def test_pool_deferred_request_is_sheddable(tiny):
+    """Regression (review): an admission pool-fault deferral un-claims
+    the request completely — having never run, it is NOT shed-exempt the
+    way preempted (in-flight) requests are."""
+    params, _ = tiny
+    inj = FaultInjector([FaultSpec("pool", step=0)])
+    eng = _engine(
+        params, ["inference.queue_limit=1", "inference.max_batch_size=1"],
+        inj=inj,
+    )
+    a = eng.submit_request([1, 2, 3], 8)
+    eng.step()                      # pool fault: a deferred, un-claimed
+    assert a.admit_seq == -1 and not eng._in_flight(a)
+    b = eng.submit_request([4, 5], 8, priority=1)   # queue full: a sheds
+    assert a.outcome == "shed" and not b.done
+    done = _drain_outcomes(eng)
+    assert done[b.rid].outcome == "completed"
+    eng.assert_page_accounting()
+
+
+def test_watchdog_stall_counted_not_fatal(tiny):
+    """An injected stall beyond inference.watchdog_timeout_s flags the
+    step as stalled (counted in reset_timing) — the process and the
+    outputs survive, unlike train's action='abort'."""
+    params, ref = tiny
+    inj = FaultInjector([FaultSpec("stall", step=2, stall_s=0.6)])
+    eng = _engine(params, ["inference.watchdog_timeout_s=0.2"], inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["stalled_steps"] == 1
+    assert eng._watchdog.running
+    eng.close()
+    assert not eng._watchdog.running
+    eng.close()                     # idempotent
+
+
+def test_fault_config_validation():
+    with pytest.raises(ValueError, match="queue_limit"):
+        get_config("tiny-llama", INFER + ["inference.queue_limit=0"])
+    with pytest.raises(ValueError, match="default_deadline_s"):
+        get_config(
+            "tiny-llama", INFER + ["inference.default_deadline_s=0"]
+        )
+    with pytest.raises(ValueError, match="spec_fault_limit"):
+        get_config("tiny-llama", INFER + ["inference.spec_fault_limit=0"])
+    with pytest.raises(ValueError, match="max_step_faults"):
+        get_config("tiny-llama", INFER + ["inference.max_step_faults=0"])
+    with pytest.raises(ValueError, match="watchdog_timeout_s"):
+        get_config(
+            "tiny-llama", INFER + ["inference.watchdog_timeout_s=-1"]
+        )
+    with pytest.raises(ValueError, match="kind"):
+        FaultSpec("explode", step=0)
+    with pytest.raises(ValueError, match="count"):
+        FaultSpec("dispatch", step=0, count=0)
+    cfg = get_config("tiny-llama", INFER)
+    params = init_params(cfg.model, jax.random.key(0))
+    eng = InferenceEngine(cfg, params)
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit([1, 2], 4, deadline_s=-1.0)
+
+
+def test_overload_bench_smoke():
+    """tools/serving_latency_bench.py --overload --smoke (tier-1 wiring):
+    at 2x-capacity offered load every miss is a typed shed/expiry (no
+    silent drops, no crash), sheds are all lowest-priority, and no
+    accepted request overruns its deadline by more than one step."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "serving_latency_bench.py"),
+         "--overload", "--smoke"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    verdict = lines[-1]
+    assert verdict["no_silent_drops"] is True, lines
+    assert verdict["all_typed"] is True, lines
+    assert verdict["sheds_lowest_priority_only"] is True, lines
+    assert verdict["deadline_overrun_bounded"] is True, lines
+    by_mode = {d["mode"]: d for d in lines[:-1]}
+    ov = by_mode["overload"]
+    assert ov["shed_rate"] > 0 and ov["outcomes"]["completed"] > 0, lines
+
+
+# ---------------------------------------------------------------------------
+# Heavy fault compositions (full tier)
+# ---------------------------------------------------------------------------
+
+
+@slow
+def test_fault_composition_chunked_spec_nan_quarantine(tiny):
+    """chunked prefill x speculation x NaN quarantine: the poisoned
+    decode-phase slot errors out of a MIXED step while a prompt is mid
+    chunk; neighbors byte-identical to the fault-free chunked run."""
+    params, ref = tiny
+    extra = SPEC + [
+        "inference.chunked_prefill=true",
+        "inference.prefill_chunk_tokens=16",
+        "inference.nan_guard=true",
+    ]
+    assert _engine(params, extra).generate(MIX, 8) == ref
+    inj = FaultInjector([FaultSpec("nan", step=2)])
+    eng = _engine(params, extra, inj=inj)
+    rids = [eng.submit(p, 8) for p in MIX]
+    done = _drain_outcomes(eng)
+    victims = [r for r in rids if done[r].outcome == "error:nan"]
+    assert len(victims) == 1
+    for i, rid in enumerate(rids):
+        if rid not in victims:
+            assert done[rid].generated == ref[i]
+    eng.assert_page_accounting()
+
+
+@slow
+def test_fault_composition_int8_pallas_fallback(tiny):
+    """kv_quant=int8 on the pallas path: the XLA fallback's quantized
+    pool writes are bitwise the kernel's (the round-5 scale fix), so a
+    mid-stream fallback step changes NOTHING downstream."""
+    params, _ = tiny
+    extra = ["model.kernels=pallas_interpret", "inference.kv_quant=int8"]
+    ref = _engine(params, extra).generate(MIX, 8)
+    inj = FaultInjector([
+        FaultSpec("dispatch", step=2, path="decode"),
+        FaultSpec("dispatch", step=4, path="decode"),
+    ])
+    eng = _engine(params, extra, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["dispatch_fallbacks"] == 2 and t["failed_steps"] == 0
+    eng.assert_page_accounting()
+
+
+@slow
+def test_fault_composition_swa_expiry_and_fallback(tiny):
+    """Sliding-window model: deadline expiry mid-decode releases the
+    rolled page layout cleanly, and a pallas fault falls back byte-
+    identically with the window mask intact."""
+    params, _ = tiny
+    swa = ["model.sliding_window=20"]
+    ref = _engine(params, swa).generate(MIX, 8)
+    # expiry under SWA
+    eng = _engine(params, swa)
+    r_dead = eng.submit_request(REP, 120, deadline_s=0.25)
+    r_live = eng.submit_request(MIX[1], 8)
+    eng.step()
+    time.sleep(0.3)
+    done = _drain_outcomes(eng)
+    assert done[r_dead.rid].outcome == "expired"
+    assert done[r_live.rid].generated == ref[1]
+    eng.assert_page_accounting()
+    # fallback under SWA + pallas
+    pall = swa + ["model.kernels=pallas_interpret"]
+    pref = _engine(params, pall).generate(MIX, 8)
+    assert pref == ref
+    inj = FaultInjector([FaultSpec("dispatch", step=3, path="decode")])
+    eng = _engine(params, pall, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    assert eng.reset_timing()["dispatch_fallbacks"] == 1
+
+
+@slow
+def test_fault_composition_spec_verify_fallback(tiny):
+    """The ragged Pallas verify path falls back to the XLA verify body on
+    an injected fault — acceptance decisions, rollback footprint and
+    greedy output all unchanged."""
+    params, ref = tiny
+    pall = SPEC + ["model.kernels=pallas_interpret"]
+    assert _engine(params, pall).generate(MIX, 8) == ref
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="verify")])
+    eng = _engine(params, pall, inj=inj)
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["failed_steps"] == 0
+    assert eng._spec_disabled is False
+    eng.assert_page_accounting()

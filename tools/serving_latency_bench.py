@@ -19,6 +19,13 @@ check). A final JSON line compares the two runs.
 
     python tools/serving_latency_bench.py          # on-chip numbers
     python tools/serving_latency_bench.py --smoke  # tiny CPU logic check
+
+``--overload`` (ISSUE 6 robustness): a 2x-capacity offered burst in two
+priority classes against a bounded admission queue with per-request
+deadlines. Reports typed-outcome accounting (completed/shed/expired —
+no silent drops), shed rate and shed priorities, accepted-request
+TTFT/ITL percentiles vs an uncontended run, and the worst deadline
+overrun in steps (expiry reaping bounds it at ~1 by construction).
 """
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
@@ -108,6 +115,201 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
     }
 
 
+def _serve_outcomes(eng, subs, deadline_s):
+    """Submit every (prompt, priority, new_tokens) up front — the offered
+    burst — then step the engine dry. Returns per-request records (typed
+    outcome, TTFT, ITL gaps, deadline overrun) and the per-step wall
+    times; every submitted request is accounted for (no silent drops)."""
+    recs = []
+    for prompt, prio, new in subs:
+        req = eng.submit_request(
+            prompt, new, priority=prio, deadline_s=deadline_s
+        )
+        recs.append({
+            "req": req, "priority": prio,
+            "submit": time.perf_counter(),
+            "first": None, "last": None, "seen": 0, "gaps": [],
+            "end_mono": None,
+        })
+    by_rid = {r["req"].rid: r for r in recs}
+    step_times = []
+    while eng.has_work():
+        ts = time.perf_counter()
+        finished = eng.step()
+        now = time.perf_counter()
+        step_times.append(now - ts)
+        for r in recs:
+            n = len(r["req"].generated)
+            if n > r["seen"]:
+                if r["first"] is None:
+                    r["first"] = now
+                else:
+                    r["gaps"].append(now - r["last"])
+                    for _ in range(n - r["seen"] - 1):
+                        r["gaps"].append(0.0)
+                r["last"] = now
+                r["seen"] = n
+        t_mono = time.monotonic()
+        for req in finished:
+            if req.rid in by_rid:
+                by_rid[req.rid]["end_mono"] = t_mono
+    return recs, step_times
+
+
+def _overload_summary(recs, step_times, mode):
+    """Aggregate one overload run: typed-outcome counts, accepted-request
+    TTFT/ITL percentiles, shed priorities and the worst deadline overrun
+    measured in steps (expiry reaping at step boundaries bounds it at ~1
+    by construction — the structural no-silent-miss check)."""
+    from orion_tpu.metrics import LatencyStats
+
+    outcomes = {}
+    for r in recs:
+        outcomes[r["req"].outcome] = outcomes.get(r["req"].outcome, 0) + 1
+    ttft, itl = LatencyStats(), LatencyStats()
+    for r in recs:
+        if r["req"].outcome != "completed":
+            continue
+        if r["first"] is not None:
+            ttft.record(r["first"] - r["submit"])
+        for g in r["gaps"]:
+            itl.record(g)
+    max_step = max(step_times) if step_times else 0.0
+    med_step = sorted(step_times)[len(step_times) // 2] if step_times else 0.0
+    # Deadline overrun of every request that HELD a slot to completion:
+    # a completed request that ran past its deadline would have been
+    # reaped as "expired" at the first boundary after it, so the overrun
+    # can never exceed the ONE step that spanned the deadline — measure
+    # it rather than assert it. The bound is checked in SECONDS against
+    # the run's own longest step (which may be a jit compile); the
+    # steps-denominated figure uses the MEDIAN (steady-state) step so a
+    # multi-second compile step cannot deflate a real overrun.
+    overrun_s = 0.0
+    for r in recs:
+        if r["req"].outcome == "completed" and r["end_mono"] is not None:
+            dl = r["req"].deadline
+            if dl is not None and r["end_mono"] > dl:
+                overrun_s = max(overrun_s, r["end_mono"] - dl)
+    ts, is_ = ttft.summary(), itl.summary()
+    offered = len(recs)
+    n_shed = outcomes.get("shed", 0)
+    return {
+        "mode": mode,
+        "offered": offered,
+        "outcomes": outcomes,
+        "shed_rate": round(n_shed / offered, 4) if offered else 0.0,
+        "shed_priorities": sorted(
+            {r["priority"] for r in recs if r["req"].outcome == "shed"}
+        ),
+        "ttft_p50_ms": round(ts["p50"] * 1e3, 3),
+        "ttft_p99_ms": round(ts["p99"] * 1e3, 3),
+        "itl_p50_ms": round(is_["p50"] * 1e3, 3),
+        "itl_p99_ms": round(is_["p99"] * 1e3, 3),
+        "itl_samples": is_["count"],
+        "max_deadline_overrun_s": round(overrun_s, 3),
+        "max_deadline_overrun_steps": round(
+            overrun_s / max(med_step, 1e-9), 2
+        ),
+        "max_step_s": round(max_step, 3),
+        "steps": len(step_times),
+    }
+
+
+def overload_main(smoke: bool) -> int:
+    """--overload: 2x-capacity offered load against a bounded queue with
+    two priority classes; one JSON line per mode (uncontended / overload)
+    plus a verdict line. The overload engine must DEGRADE — typed sheds
+    of the lowest class, feasible deadlines kept — never crash or
+    silently drop."""
+    from orion_tpu.config import get_config
+    from orion_tpu.infer import InferenceEngine
+    from orion_tpu.models import init_params
+
+    if smoke:
+        preset, base = "tiny-llama", [
+            "model.max_seq_len=1024",
+            "inference.max_seq_len=1024", "inference.page_size=64",
+            "inference.num_pages=48", "inference.max_batch_size=4",
+            "inference.prefill_chunk=64", "inference.decode_window=1",
+        ]
+        prompt_len, new_tokens, deadline_s = 8, 24, 60.0
+    else:
+        preset, base = "llama-1b-bench", [
+            "model.param_dtype=bfloat16",
+            "inference.max_seq_len=2048", "inference.page_size=64",
+            "inference.num_pages=1024", "inference.max_batch_size=8",
+            "inference.prefill_chunk=256", "inference.decode_window=1",
+        ]
+        prompt_len, new_tokens, deadline_s = 32, 128, 120.0
+
+    cfg = get_config(preset, base)
+    B = cfg.inference.max_batch_size
+    # Offered = 2x the slot capacity (B high + B low, interleaved) in one
+    # burst; the queue is bounded at B, so the overload MUST shed the
+    # surplus — and the priority/deadline victim rule sheds exactly the
+    # low class, leaving the accepted set identical to the uncontended
+    # run's (the clean SLO comparison).
+    qcfg = get_config(preset, base + [
+        f"inference.queue_limit={B}",
+    ])
+    rng = np.random.default_rng(0)
+    V = cfg.model.vocab_size
+    mk = lambda: rng.integers(1, V, prompt_len).tolist()
+    params = init_params(cfg.model, jax.random.key(0))
+
+    results = {}
+    for mode in ("uncontended", "overload"):
+        c = cfg if mode == "uncontended" else qcfg
+        eng = InferenceEngine(c, params)
+        if mode == "uncontended":
+            subs = [(mk(), 1, new_tokens) for _ in range(B)]
+        else:
+            # interleave hi/lo so the bounded queue always holds both
+            # classes when the shed decision fires
+            subs = []
+            for _ in range(B):
+                subs.append((mk(), 1, new_tokens))
+                subs.append((mk(), 0, new_tokens))
+        # Compile pass at the serving shapes, then the timed pass.
+        _serve_outcomes(eng, [(mk(), 1, 4)], deadline_s)
+        recs, step_times = _serve_outcomes(eng, subs, deadline_s)
+        eng.assert_page_accounting()
+        r = _overload_summary(recs, step_times, mode)
+        t = eng.reset_timing()
+        r["engine_shed"] = t["shed_requests"]
+        r["engine_expired"] = t["expired_requests"]
+        results[mode] = r
+        print(json.dumps(r))
+    un, ov = results["uncontended"], results["overload"]
+    acc = {
+        k: v for k, v in ov["outcomes"].items()
+        if k not in ("shed", "expired")
+    }
+    verdict = {
+        # Structural: every offered request carries exactly one typed
+        # outcome; the surplus shed, and only from the lowest class.
+        "no_silent_drops": sum(ov["outcomes"].values()) == ov["offered"],
+        "all_typed": set(ov["outcomes"]) <= {"completed", "shed", "expired"},
+        "sheds_lowest_priority_only": ov["shed_priorities"] in ([], [0]),
+        # Reap-at-boundary structural bound: an overrun can never exceed
+        # the one (possibly compile-length) step spanning the deadline.
+        "deadline_overrun_bounded":
+            ov["max_deadline_overrun_s"] <= ov["max_step_s"] + 1e-3,
+        "accepted_completed": sum(acc.values()),
+        # SLO: accepted-request tail latency under 2x offered load vs the
+        # uncontended run (the acceptance bar is 1.10 on-chip; CPU smoke
+        # wall clocks are noisy, so the smoke asserts structure only).
+        "ttft_p99_ratio": round(
+            ov["ttft_p99_ms"] / un["ttft_p99_ms"], 4
+        ) if un["ttft_p99_ms"] else None,
+        "itl_p99_ratio": round(
+            ov["itl_p99_ms"] / un["itl_p99_ms"], 4
+        ) if un["itl_p99_ms"] else None,
+    }
+    print(json.dumps(verdict))
+    return 0
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv[1:] or "--cpu" in sys.argv[1:]
     if smoke:
@@ -115,6 +317,8 @@ def main() -> int:
     elif jax.default_backend() != "tpu":
         print("SKIP: no TPU backend (use --smoke for the CPU logic check)")
         return 0
+    if "--overload" in sys.argv[1:]:
+        return overload_main(smoke)
 
     from orion_tpu.config import get_config
     from orion_tpu.infer import InferenceEngine

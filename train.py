@@ -47,7 +47,7 @@ def main(argv: list[str] | None = None) -> int:
     from orion_tpu.train import Trainer
 
     if args.max_restarts > 0:
-        from orion_tpu.train.fault import run_with_restarts
+        from orion_tpu.runtime.fault import run_with_restarts
 
         if not cfg.checkpoint.directory or not cfg.checkpoint.restore:
             parser.error(
