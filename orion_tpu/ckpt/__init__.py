@@ -1,10 +1,14 @@
 """Checkpoint/resume (reference ``orion.checkpoint`` equivalent).
 
-BASELINE.json:5 prescribes the mapping: orion.checkpoint moves to Orbax —
-async, sharded saves via tensorstore, restore into the same NamedShardings
-(SURVEY.md §4 stack E).
+Native atomic checkpointing (ISSUE 8): temp-dir + fsync + manifest
+(per-array checksum/dtype/shape/sharding + step + data-stream state) +
+atomic rename on save; checksum-validated restore that quarantines corrupt
+checkpoints with a typed reason and falls back to the newest intact one.
+Sharded per-host writes and sharded restore into the target's
+NamedShardings keep the Orbax-era property that a 70B state never
+materializes unsharded (SURVEY.md §4 stack E).
 """
 
-from orion_tpu.ckpt.checkpoint import CheckpointManager
+from orion_tpu.ckpt.checkpoint import CheckpointManager, CorruptCheckpoint
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "CorruptCheckpoint"]

@@ -404,6 +404,25 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     restore: bool = True             # restore_or_init on startup
+    # Restore-time integrity checking (ckpt/checkpoint.py): every array
+    # file's checksum is validated against the manifest before the state is
+    # materialized; a corrupt checkpoint is QUARANTINED (moved aside with a
+    # typed reason) and restore falls back to the newest intact one. Off
+    # skips the checksum pass (manifest/shape checks still run) for very
+    # large states where the extra read dominates restore time.
+    verify_restore: bool = True
+
+    def __post_init__(self):
+        if self.save_interval_steps is None or self.save_interval_steps < 1:
+            raise ValueError(
+                f"checkpoint.save_interval_steps={self.save_interval_steps} "
+                f"must be >= 1"
+            )
+        if self.max_to_keep is not None and self.max_to_keep < 1:
+            raise ValueError(
+                f"checkpoint.max_to_keep={self.max_to_keep} must be >= 1 "
+                f"(or none to keep all)"
+            )
 
 
 @dataclass(frozen=True)
@@ -462,6 +481,56 @@ class TrainConfig:
     # with pure DP (fsdp=tp=pp=sp=ep=1) — the bandwidth win targets the
     # DCN-crossing dp axis of hybrid meshes. None => full-precision psum.
     grad_quant_bits: Optional[int] = None
+    # --- Fault tolerance (README "Training robustness") -------------------
+    # Gradient anomaly guard: fold a donation-safe all-finite (loss + every
+    # grad leaf) and global-norm-spike check into the compiled train step.
+    # An anomalous step is SKIPPED — params, moments and the schedule count
+    # come out bit-identical to the pre-step state — and counted
+    # (metrics.TrainRobustnessStats). Off by default so the compiled step
+    # stays bit-for-bit the pre-guard program.
+    anomaly_guard: bool = False
+    # Spike threshold: a step whose global grad norm exceeds
+    # anomaly_spike_factor x the running norm EMA counts as anomalous even
+    # when finite (a loss-spike/bad-batch signature). The EMA is
+    # host-maintained and persisted in the checkpoint manifest so resume
+    # reproduces the same skip decisions bitwise. None = finite-check only.
+    anomaly_spike_factor: Optional[float] = None
+    # EMA decay for the reference grad norm (only with anomaly_spike_factor).
+    anomaly_ema_beta: float = 0.9
+    # After this many CONSECUTIVE anomalous (skipped) steps the poison is
+    # clearly not transient: auto-rollback restores the newest intact
+    # checkpoint and fast-forwards the data cursor past the poisoned batch
+    # window (loader.skip_batches) before continuing.
+    anomaly_limit: int = 3
+    # Emergency checkpoint on preemption (SIGTERM inside the grace window)
+    # and on crash/interrupt paths: force-save the newest complete state
+    # after awaiting any in-flight async save. Off = rely on periodic saves.
+    emergency_ckpt: bool = True
+    # Supervisor restarts (train.py --max-restarts overrides): rebuild the
+    # trainer and resume from the newest intact checkpoint after a
+    # recoverable failure, up to this many times. 0 = crash on first fault.
+    max_restarts: int = 0
+
+    def __post_init__(self):
+        if self.anomaly_limit is None or self.anomaly_limit < 1:
+            raise ValueError(
+                f"train.anomaly_limit={self.anomaly_limit} must be >= 1"
+            )
+        if self.anomaly_spike_factor is not None \
+                and self.anomaly_spike_factor <= 1.0:
+            raise ValueError(
+                f"train.anomaly_spike_factor={self.anomaly_spike_factor} "
+                f"must be > 1 (norm ratio vs the running EMA), or none"
+            )
+        if not 0.0 < self.anomaly_ema_beta < 1.0:
+            raise ValueError(
+                f"train.anomaly_ema_beta={self.anomaly_ema_beta} must be "
+                f"in (0, 1)"
+            )
+        if self.max_restarts is None or self.max_restarts < 0:
+            raise ValueError(
+                f"train.max_restarts={self.max_restarts} must be >= 0"
+            )
 
 
 @dataclass(frozen=True)

@@ -50,7 +50,17 @@ pack_stats = {"dropped_tokens": 0}
 
 
 class Loader(abc.ABC):
-    """Per-host view of a deterministic global batch stream."""
+    """Per-host view of a deterministic global batch stream.
+
+    The stream is a pure function of ``(shuffle_seed, data_step)`` where
+    ``data_step = step + offset``: the ``offset`` cursor (default 0) is the
+    loader's ONLY mutable state, serialized into every checkpoint manifest
+    (``state_dict``/``load_state_dict``) so resume replays the identical
+    token order bitwise. ``skip_batches`` advances the cursor without
+    advancing the optimizer step — the auto-rollback path uses it to
+    fast-forward past a poisoned batch window (the skipped optimizer steps
+    then draw fresh batches instead of replaying the poison).
+    """
 
     def __init__(self, cfg: DataConfig, process_index: int, process_count: int):
         if cfg.batch_size % process_count:
@@ -62,6 +72,7 @@ class Loader(abc.ABC):
         self.process_index = process_index
         self.process_count = process_count
         self.host_batch = cfg.batch_size // process_count
+        self.offset = 0
         if process_index == 0:
             log.info("data stream format v%d (seed=%s): resuming a "
                      "checkpoint written under an older format replays a "
@@ -71,6 +82,45 @@ class Loader(abc.ABC):
     @abc.abstractmethod
     def batch_at(self, step: int) -> Batch:
         """Host-local shard: inputs/targets [host_batch, seq_len] int32."""
+
+    # -- serializable cursor (checkpoint manifest "loader" entry) ---------
+
+    def data_step(self, step: int) -> int:
+        """The stream index a trainer step maps to (cursor applied)."""
+        return step + self.offset
+
+    def skip_batches(self, n: int) -> None:
+        """Advance the cursor by ``n`` global batches (auto-rollback's
+        poison-window fast-forward). Negative n is rejected: the stream
+        never rewinds — resume-equivalence owns replay, not the cursor."""
+        if n < 0:
+            raise ValueError(f"skip_batches({n}): cursor never rewinds")
+        self.offset += n
+
+    def state_dict(self) -> dict:
+        return {
+            "version": 1,
+            "offset": self.offset,
+            "stream_format": STREAM_FORMAT,
+            "shuffle_seed": self.cfg.shuffle_seed,
+        }
+
+    def load_state_dict(self, state: Mapping) -> None:
+        fmt = state.get("stream_format")
+        if fmt is not None and fmt != STREAM_FORMAT:
+            log.warning(
+                "loader state was written under data-stream format %s but "
+                "this build uses format %d: resume continues on a "
+                "DIFFERENT token order", fmt, STREAM_FORMAT,
+            )
+        seed = state.get("shuffle_seed")
+        if seed is not None and seed != self.cfg.shuffle_seed:
+            log.warning(
+                "loader state was written under shuffle_seed=%s but this "
+                "run uses %s: resume continues on a different token order",
+                seed, self.cfg.shuffle_seed,
+            )
+        self.offset = int(state.get("offset", 0))
 
 
 def pack_rows(
@@ -172,10 +222,11 @@ class SyntheticLoader(Loader):
         return {k: v[lo : lo + self.host_batch] for k, v in batch.items()}
 
     def batch_at(self, step: int) -> Batch:
-        # Generate the GLOBAL batch (seeded by step only), then slice this
-        # host's rows — the stream is process-count invariant by design.
+        # Generate the GLOBAL batch (seeded by the cursor-adjusted step
+        # only), then slice this host's rows — the stream is process-count
+        # invariant by design.
         gb, s = self.cfg.batch_size, self.cfg.seq_len
-        rng = np.random.default_rng((self.cfg.shuffle_seed, step))
+        rng = np.random.default_rng((self.cfg.shuffle_seed, self.data_step(step)))
         if self.cfg.packed:
             rows = []
             for _ in range(gb):
@@ -219,9 +270,12 @@ class MemmapLoader(Loader):
         self.n_windows = self.n_tokens - need + 1
 
     def _offsets_at(self, step: int) -> np.ndarray:
-        # Global offsets (seeded by step only): every host draws the same
-        # window set and slices its rows — process-count invariant.
-        rng = np.random.default_rng((self.cfg.shuffle_seed, step))
+        # Global offsets (seeded by the cursor-adjusted step only): every
+        # host draws the same window set and slices its rows —
+        # process-count invariant.
+        rng = np.random.default_rng(
+            (self.cfg.shuffle_seed, self.data_step(step))
+        )
         return rng.integers(0, self.n_windows, size=self.cfg.batch_size)
 
     def batch_at(self, step: int) -> Batch:

@@ -208,6 +208,47 @@ class RobustnessStats:
 
 
 @dataclass
+class TrainRobustnessStats:
+    """Training-side fault-tolerance counters (ISSUE 8), owned by the
+    Trainer — the twin of the serving engine's ``RobustnessStats``.
+
+    ``anomalous_steps`` counts compiled-step skips by the gradient anomaly
+    guard (``train.anomaly_guard``), split into ``nonfinite_steps`` (NaN/Inf
+    in the loss or any grad leaf) and ``spike_steps`` (finite but the global
+    grad norm exceeded ``train.anomaly_spike_factor`` x the running EMA); a
+    skipped step leaves params/optimizer bit-identical to pre-step.
+    ``rollbacks`` counts auto-rollback episodes (``train.anomaly_limit``
+    consecutive anomalies -> restore newest intact checkpoint + skip the
+    poisoned batch window), ``skipped_batches`` the data-cursor fast-forward
+    those episodes applied. ``emergency_saves`` counts preemption/crash
+    force-saves, ``corrupt_checkpoints`` the checkpoints restore quarantined
+    with a typed reason before finding an intact one, ``restarts`` the
+    supervisor attempt number this fit is running under
+    (``run_with_restarts``), and ``last_fault_reason`` why the previous
+    attempt died (carried into the step log).
+    """
+
+    anomalous_steps: int = 0
+    nonfinite_steps: int = 0
+    spike_steps: int = 0
+    rollbacks: int = 0
+    skipped_batches: int = 0
+    emergency_saves: int = 0
+    corrupt_checkpoints: int = 0
+    restarts: int = 0
+    last_fault_reason: Optional[str] = None
+
+    def as_extras(self) -> dict[str, float]:
+        """Flatten into MetricsLogger extras (floats only; the reason
+        string rides the log line, not the JSONL row)."""
+        return {
+            "anomalous_steps": float(self.anomalous_steps),
+            "rollbacks": float(self.rollbacks),
+            "restarts": float(self.restarts),
+        }
+
+
+@dataclass
 class LatencyStats:
     """Streaming latency collector for the serving benches (SURVEY.md §6
     metrics): record per-event wall times (TTFT, inter-token gaps), report

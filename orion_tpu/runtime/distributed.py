@@ -83,6 +83,73 @@ def initialize(cfg: Optional[RuntimeConfig] = None) -> RuntimeInfo:
     return runtime_info(cfg.platform)
 
 
+# ---------------------------------------------------------------------------
+# Multi-host agreement (checkpoint fault tolerance, ISSUE 8)
+#
+# Restore must be a FLEET decision: with per-host shard files, a checkpoint
+# step is usable only if EVERY host finds its portion intact. These helpers
+# are trivially pass-through single-process (the CPU test tier) and ride
+# jax's multihost allgather otherwise.
+# ---------------------------------------------------------------------------
+
+# Fixed-width padding for the step-set allgather: every host must
+# contribute the same shape. max_to_keep is small (single digits); 128
+# leaves room for keep-all directories without a dynamic handshake.
+_AGREE_PAD = 128
+
+
+def agree_on_steps(local_steps) -> list:
+    """The checkpoint steps ALL hosts can see, sorted ascending.
+
+    Each host passes the step numbers of the committed checkpoint
+    directories it can list; the result is the intersection across hosts —
+    a step some host lost (partial upload, torn local disk) is excluded
+    before anyone tries to validate it. Single-process: sorted passthrough.
+    """
+    local = sorted(set(int(s) for s in local_steps))
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    if len(local) > _AGREE_PAD:
+        local = local[-_AGREE_PAD:]  # newest window; older ones are GC fodder
+    padded = np.full((_AGREE_PAD,), -1, dtype=np.int64)
+    padded[: len(local)] = local
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    sets = [set(int(v) for v in row if v >= 0) for row in gathered]
+    return sorted(set.intersection(*sets)) if sets else []
+
+
+def agree_all(ok: bool, tag: str = "agree_all") -> bool:
+    """True iff every host reports ``ok`` (checkpoint-intact consensus).
+
+    Used per candidate step during restore fallback: a host whose shard
+    files fail validation votes no, and every host moves to the next
+    candidate together. Single-process: identity.
+    """
+    if jax.process_count() == 1:
+        return bool(ok)
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    votes = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray([1 if ok else 0], dtype=np.int32)
+        )
+    )
+    return bool(votes.min() == 1)
+
+
+def barrier(tag: str) -> None:
+    """Cross-host sync point (commit ordering for multi-host saves)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
 def runtime_info(platform: Optional[str] = None) -> RuntimeInfo:
     devs = jax.devices(platform) if platform else jax.devices()
     local = jax.local_devices(backend=platform) if platform else jax.local_devices()

@@ -22,12 +22,19 @@ TPU-native mapping of the reference's torchelastic-class machinery:
     Training uses action="abort" (a hung collective is unrecoverable
     in-process); the serving engine uses the default flag-only callback so
     a stalled step fails the STEP, never the process.
-  - ``FaultInjector`` — the serving-path injection harness
+  - ``FaultInjector`` — the shared injection harness. Serving
     (InferenceEngine(..., fault_injector=...)): dispatch exceptions, NaN
     logits (page poisoning), page-pool exhaustion and artificial step
-    stalls, each at a configured engine step. Training keeps its own hook
-    (train.inject_fault_at_step) — same closing-the-loop idea: tests crash
-    a real run and assert recovery.
+    stalls, each at a configured engine step. Training (ISSUE 8;
+    Trainer(..., fault_injector=...) consults the same ``take()`` with
+    path="train"): "dispatch" raises before the compiled step runs (feeds
+    run_with_restarts), "nan" routes the step through a poisoned loss so
+    REAL NaNs flow through the real backward into every grad leaf (the
+    anomaly guard's quarry), and "partial_write" tears the checkpoint
+    commit (an array file is truncated after its manifest checksum was
+    recorded — restore must detect and fall back). The legacy
+    train.inject_fault_at_step hook remains — same closing-the-loop idea:
+    tests crash a real run and assert recovery.
 """
 
 from __future__ import annotations
@@ -116,6 +123,7 @@ def run_with_restarts(
     retry_on: tuple[Type[BaseException], ...] = (Exception,),
     non_retryable: tuple[Type[BaseException], ...] = (ValueError, TypeError),
     backoff_s: float = 0.0,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
 ) -> object:
     """Supervisor loop: call ``make_and_fit(attempt)``, restarting on failure.
 
@@ -124,6 +132,11 @@ def run_with_restarts(
     checkpoint. KeyboardInterrupt and Preempted always propagate — those are
     orderly shutdowns, not failures — as do ``non_retryable`` types
     (config/typo errors are deterministic; retrying them wastes compute).
+
+    ``on_retry(attempt, exc)`` fires before each restart with the attempt
+    number about to run and the exception that killed the previous one —
+    the hook train.py uses to thread the restart count and last fault
+    reason into the next attempt's step log.
     """
     attempt = 0
     while True:
@@ -142,6 +155,8 @@ def run_with_restarts(
                 "attempt %d failed (%s: %s); restarting (%d/%d)",
                 attempt - 1, type(e).__name__, e, attempt, max_restarts,
             )
+            if on_retry is not None:
+                on_retry(attempt, e)
             if backoff_s:
                 time.sleep(backoff_s)
 
@@ -291,11 +306,28 @@ class FaultSpec:
       - "stall":    sleep ``stall_s`` inside the dispatch path (trips the
         engine watchdog when stall_s > inference.watchdog_timeout_s).
 
+    Training-path kinds (Trainer(..., fault_injector=...); ``step`` is the
+    trainer step, ``path`` is "train"):
+      - "dispatch": raise InjectedFault before the compiled train step runs
+        (state untouched; a supervisor restart resumes from the newest
+        checkpoint).
+      - "nan":      run this step through the poisoned-loss variant of the
+        SAME compiled program family — loss multiplied by NaN inside the
+        differentiated function, so every grad leaf comes out NaN through
+        the real backward (requires train.anomaly_guard for the step to be
+        skipped instead of poisoning the params forever).
+      - "partial_write": tear the checkpoint commit at this step (the
+        CheckpointManager consumes it with path="ckpt") — one array file
+        is truncated AFTER its checksum landed in the manifest, then the
+        rename commits anyway, modeling post-rename data loss; restore
+        must checksum-detect it, quarantine, and fall back.
+
     ``step`` is the engine step number (``InferenceEngine.step_no``) to fire
     at; ``path`` optionally restricts dispatch/stall faults to one coarse
     dispatch path ("prefill" | "decode" | "verify" | "mixed" |
-    "mixed_verify"); ``rid`` optionally selects the nan victim (default: the
-    oldest active request). ``count`` fires the spec that many times.
+    "mixed_verify" | "train"); ``rid`` optionally selects the nan victim
+    (default: the oldest active request). ``count`` fires the spec that
+    many times.
     """
 
     kind: str
@@ -306,7 +338,9 @@ class FaultSpec:
     count: int = 1
 
     def __post_init__(self):
-        if self.kind not in ("dispatch", "nan", "pool", "stall"):
+        if self.kind not in (
+            "dispatch", "nan", "pool", "stall", "partial_write"
+        ):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.count < 1:
             raise ValueError(f"count must be >= 1, got {self.count}")

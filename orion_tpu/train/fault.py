@@ -1,12 +1,23 @@
-"""Compatibility shim: the fault-tolerance machinery moved to
+"""Deprecated compatibility shim: the fault-tolerance machinery moved to
 ``orion_tpu.runtime.fault`` so the serving stack can share it (preemption
-drains, the stall watchdog, fault injection). Import from there."""
+drains, the stall watchdog, fault injection, run_with_restarts). Import
+from there; this shim lasts one release and warns on import.
+"""
+
+import warnings
 
 from orion_tpu.runtime.fault import (  # noqa: F401
     Preempted,
     PreemptionHandler,
     Watchdog,
     run_with_restarts,
+)
+
+warnings.warn(
+    "orion_tpu.train.fault moved to orion_tpu.runtime.fault; this shim "
+    "will be removed next release",
+    DeprecationWarning,
+    stacklevel=2,
 )
 
 __all__ = ["Preempted", "PreemptionHandler", "Watchdog", "run_with_restarts"]

@@ -78,17 +78,38 @@ def global_norm(tree: Any) -> jax.Array:
     )
 
 
+def tree_all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every element of every floating leaf is finite.
+
+    The gradient anomaly guard's device-side check (train.anomaly_guard):
+    ONE fused reduction over the grad tree, cheap next to the backward
+    pass it follows. Non-floating leaves (step counters) are skipped —
+    integers are always finite and isfinite rejects them.
+    """
+    ok = jnp.bool_(True)
+    for leaf in jax.tree.leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
 def apply_updates(
     params: Any,
     grads: Any,
     opt_state: OptState,
     cfg: OptimizerConfig,
     learning_rate: jax.Array,
+    gnorm: Optional[jax.Array] = None,
 ) -> tuple[Any, OptState, dict[str, jax.Array]]:
-    """One optimizer update. Returns (params, opt_state, metrics)."""
+    """One optimizer update. Returns (params, opt_state, metrics).
+
+    ``gnorm`` lets a caller that already computed the global grad norm
+    (the anomaly guard) share it instead of paying the reduction twice.
+    """
     if cfg.name not in ("adamw", "sgd"):
         raise ValueError(f"unknown optimizer {cfg.name!r}")
-    gnorm = global_norm(grads)
+    if gnorm is None:
+        gnorm = global_norm(grads)
     if cfg.grad_clip_norm > 0:
         scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-9))
     else:

@@ -28,8 +28,10 @@ from orion_tpu.parallel import batch_sharding, param_shardings
 from orion_tpu.runtime import build_mesh, initialize
 from orion_tpu.train.optimizer import (
     apply_updates,
+    global_norm,
     init_opt_state,
     make_schedule,
+    tree_all_finite,
 )
 
 log = logging.getLogger("orion_tpu.train")
@@ -39,6 +41,12 @@ TrainState = dict[str, Any]
 
 class FaultInjected(RuntimeError):
     """Raised by the --inject_fault_at_step test hook (SURVEY.md §6)."""
+
+
+class RollbackFailed(RuntimeError):
+    """Auto-rollback (train.anomaly_limit consecutive anomalies) found no
+    intact checkpoint to restore. Retryable by run_with_restarts only in
+    the sense that a supervisor restart re-inits from scratch."""
 
 
 # Each injected fault fires once per (checkpoint dir, step) per process, so
@@ -93,13 +101,36 @@ def make_train_step(
     cfg: Config,
     schedule: Callable[[jax.Array], jax.Array],
     mesh: Any = None,
-) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    poison: bool = False,
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the compiled per-step function.
+
+    With ``train.anomaly_guard`` the returned callable takes a third
+    ``norm_limit`` scalar (the host-maintained spike threshold) and folds a
+    donation-safe all-finite + global-norm-spike check into the program:
+    an anomalous step selects the PRE-step params/optimizer back out
+    bit-identically and reports ``anomaly``/``nonfinite``/``spike`` flags
+    in the step metrics. Guard off returns exactly the pre-guard two-arg
+    program (trace bit-identical — no finiteness ops are ever staged).
+
+    ``poison=True`` builds the fault-injection variant: the loss is
+    multiplied by NaN INSIDE the differentiated function, so real NaNs
+    flow through the real backward into every grad leaf (the trainer
+    dispatches one step through this program when a FaultInjector "nan"
+    spec fires).
+    """
     mcfg = cfg.model
     accum = cfg.train.grad_accum
     gdt = (
         jnp.dtype(cfg.train.grad_dtype)
         if cfg.train.grad_dtype is not None else None
     )
+    if poison:
+        def _loss_fn(p, mb, m, mesh_):
+            loss, aux = loss_fn(p, mb, m, mesh_)
+            return loss * jnp.float32(jnp.nan), aux
+    else:
+        _loss_fn = loss_fn
 
     def _value_and_grad(params, mb):
         """value_and_grad of the loss; under train.grad_dtype the grads are
@@ -114,7 +145,7 @@ def make_train_step(
                 if jnp.issubdtype(p.dtype, jnp.floating) else p,
                 params,
             )
-        return jax.value_and_grad(loss_fn, has_aux=True)(
+        return jax.value_and_grad(_loss_fn, has_aux=True)(
             params, mb, mcfg, mesh
         )
 
@@ -241,7 +272,56 @@ def make_train_step(
         }
         return new_state, step_metrics
 
-    return train_step
+    if not cfg.train.anomaly_guard:
+        return train_step
+
+    def guarded_step(state: TrainState, batch, norm_limit):
+        """train_step + the gradient anomaly guard (ISSUE 8).
+
+        Donation-safe skip: the old params/moments are read BEFORE the
+        update and selected back per leaf when the step is anomalous, so
+        a skipped step's outputs are byte-identical to the pre-step state
+        even with the inputs donated (XLA still aliases the buffers —
+        shapes/dtypes match — and `where` reads happen before writes).
+        The schedule count only advances on applied steps, mirroring
+        standard skip-nonfinite optimizers: a skipped batch neither moves
+        the params nor burns an LR-schedule position.
+        """
+        params = state["params"]
+        with jax.named_scope("fwd_bwd"):
+            loss, aux, grads = grads_fn(params, batch)
+        with jax.named_scope("anomaly_guard"):
+            gnorm = global_norm(grads)
+            finite = jnp.logical_and(
+                jnp.isfinite(loss), tree_all_finite(grads)
+            )
+            spike = jnp.logical_and(finite, gnorm > norm_limit)
+            ok = jnp.logical_and(finite, jnp.logical_not(spike))
+        lr = schedule(state["opt"]["count"]).astype(jnp.float32)
+        with jax.named_scope("optimizer"):
+            new_params, new_opt, opt_metrics = apply_updates(
+                params, grads, state["opt"], cfg.optimizer, lr, gnorm=gnorm
+            )
+        keep = lambda new, old: jnp.where(ok, new, old)
+        new_state = {
+            "params": jax.tree.map(keep, new_params, params),
+            "opt": jax.tree.map(keep, new_opt, state["opt"]),
+            "step": state["step"] + 1,
+        }
+        f32 = jnp.float32
+        step_metrics = {
+            "loss": loss,
+            "ce_loss": aux["ce_loss"],
+            "moe_aux": aux["moe_aux"],
+            "grad_norm": gnorm,
+            "lr": lr,
+            "anomaly": jnp.logical_not(ok).astype(f32),
+            "nonfinite": jnp.logical_not(finite).astype(f32),
+            "spike": spike.astype(f32),
+        }
+        return new_state, step_metrics
+
+    return guarded_step
 
 
 class Trainer:
@@ -252,9 +332,16 @@ class Trainer:
     restore -> jit train_step -> loop.
     """
 
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, fault_injector: Optional[Any] = None):
         import dataclasses as _dc
 
+        self.fault_injector = fault_injector
+        if cfg.runtime.checkify and cfg.train.anomaly_guard:
+            raise ValueError(
+                "train.anomaly_guard handles non-finite steps by skipping "
+                "them in-program; runtime.checkify raises host-side on the "
+                "same condition — pick one"
+            )
         if cfg.model.weight_quant is not None:
             raise ValueError(
                 "model.weight_quant is a serving-only knob (the engine "
@@ -430,6 +517,7 @@ class Trainer:
         self.batch_shard = self._batch_sharding()
         self.loader = make_loader(cfg.data, cfg.model.vocab_size)
         schedule = make_schedule(cfg.optimizer, cfg.train.num_steps)
+        self._schedule = schedule
         base_step = make_train_step(self.cfg, schedule, self.mesh)
         if cfg.runtime.checkify:
             # Sanitizer mode (SURVEY.md §6, SANITIZERS.md): functionalized
@@ -492,8 +580,8 @@ class Trainer:
 
             inner_step = self.train_step
 
-            def _asserted_step(state, batch):
-                out = inner_step(state, batch)
+            def _asserted_step(*args):
+                out = inner_step(*args)
                 jax.block_until_ready(out[1])
                 # Output readiness does not order the async callback
                 # thread; the barrier does — without it a failure could
@@ -521,8 +609,23 @@ class Trainer:
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint.directory:
             self.ckpt = CheckpointManager(
-                cfg.checkpoint.directory, cfg.checkpoint
+                cfg.checkpoint.directory, cfg.checkpoint,
+                fault_injector=fault_injector,
             )
+        # Anomaly-guard host state (persisted in the checkpoint manifest so
+        # resume reproduces the exact skip decisions) + robustness counters.
+        self._gnorm_ema: Optional[float] = None
+        self._anomaly_run = 0
+        self._poison_jit = None
+        self.robustness = metrics_lib.TrainRobustnessStats()
+        # The PRNG key the run was seeded with, recorded in every manifest
+        # (pillar 2: a resumed run must be able to prove it continues the
+        # same key lineage).
+        self._prng_key_data = [
+            int(x) for x in np.ravel(
+                jax.random.key_data(jax.random.key(cfg.train.seed))
+            )
+        ]
         # data.batch_size is the global batch per optimizer step; grad_accum
         # only splits it into microbatches and must not inflate throughput.
         tokens_per_step = cfg.data.batch_size * cfg.data.seq_len
@@ -578,7 +681,11 @@ class Trainer:
                                            sharding=a.sharding),
             self.global_batch(0),
         )
-        compiled = self._jit_step.lower(state, batch).compile()
+        args = (state, batch)
+        if self.cfg.train.anomaly_guard:
+            # The guarded program takes the host-fed spike threshold too.
+            args = (*args, jax.ShapeDtypeStruct((), jnp.float32))
+        compiled = self._jit_step.lower(*args).compile()
         ma = compiled.memory_analysis()
 
         def _nbytes(leaf):
@@ -622,10 +729,119 @@ class Trainer:
     def restore_or_init(self) -> tuple[TrainState, int]:
         if self.ckpt is not None and self.cfg.checkpoint.restore:
             restored = self.ckpt.restore_latest(self.abstract_state())
+            self.robustness.corrupt_checkpoints += len(self.ckpt.quarantined)
             if restored is not None:
                 state, step = restored
+                self._apply_restore_extra(self.ckpt.last_restore_extra)
                 return state, step
         return self.init_state(), 0
+
+    def _apply_restore_extra(self, extra: Optional[dict]) -> None:
+        """Rehydrate the host-side resume state the manifest carried:
+        data-loader cursor, anomaly-guard EMA/run, PRNG-lineage check."""
+        if not extra:
+            return
+        if extra.get("loader"):
+            loader_state = dict(extra["loader"])
+            # The manifest-level stream-format check already warned on a
+            # mismatch; don't let load_state_dict repeat it.
+            loader_state.pop("stream_format", None)
+            self.loader.load_state_dict(loader_state)
+        if "gnorm_ema" in extra:
+            self._gnorm_ema = extra["gnorm_ema"]
+        self._anomaly_run = int(extra.get("anomaly_run") or 0)
+        key = extra.get("prng_key")
+        if key is not None and list(key) != self._prng_key_data:
+            log.warning(
+                "checkpoint was written under a different train.seed PRNG "
+                "key (%s vs %s): any key-derived randomness diverges from "
+                "the original run", key, self._prng_key_data,
+            )
+
+    def _ckpt_extra(self) -> dict:
+        extra = {
+            "loader": self.loader.state_dict(),
+            "train_seed": self.cfg.train.seed,
+            "prng_key": self._prng_key_data,
+        }
+        if self.cfg.train.anomaly_guard:
+            extra["gnorm_ema"] = self._gnorm_ema
+            extra["anomaly_run"] = self._anomaly_run
+        return extra
+
+    def _spike_limit(self) -> np.float32:
+        """The norm threshold fed to the guarded step: factor x the
+        running EMA, or +inf while no reference exists (first steps, or
+        spike checking disabled — finiteness is still checked)."""
+        factor = self.cfg.train.anomaly_spike_factor
+        if factor is None or not self._gnorm_ema:
+            return np.float32(np.inf)
+        return np.float32(factor * self._gnorm_ema)
+
+    def _poison_variant(self):
+        """The FaultInjector "nan" step program, compiled on first use
+        (same config/schedule family; the loss is NaN-poisoned inside the
+        differentiated function, so every grad leaf comes out NaN through
+        the real backward)."""
+        if self._poison_jit is None:
+            self._poison_jit = jax.jit(
+                make_train_step(
+                    self.cfg, self._schedule, self.mesh, poison=True
+                ),
+                donate_argnums=(0,),
+            )
+        return self._poison_jit
+
+    def _rollback(self, failed_step: int) -> tuple[TrainState, int]:
+        """Auto-rollback after train.anomaly_limit consecutive anomalies:
+        restore the newest intact checkpoint and fast-forward the data
+        cursor past the poisoned batch window, so the replayed optimizer
+        steps draw fresh batches instead of the poison. Idempotent under
+        repetition — every episode skips further."""
+        stats = self.robustness
+        stats.rollbacks += 1
+        stats.last_fault_reason = (
+            f"anomaly_rollback: {self._anomaly_run} consecutive anomalous "
+            f"steps ending at step {failed_step}"
+        )
+        if self.ckpt is None:
+            raise RollbackFailed(
+                f"{self._anomaly_run} consecutive anomalous steps at step "
+                f"{failed_step} and no checkpoint.directory to roll back to"
+            )
+        restored = self.ckpt.restore_latest(self.abstract_state())
+        stats.corrupt_checkpoints += len(self.ckpt.quarantined)
+        if restored is None:
+            raise RollbackFailed(
+                f"{self._anomaly_run} consecutive anomalous steps at step "
+                f"{failed_step} and no intact checkpoint to roll back to"
+            )
+        state, good_step = restored
+        extra = self.ckpt.last_restore_extra or {}
+        loader_state = dict(extra.get("loader") or {})
+        # Defensive clamp: if the newest intact checkpoint is somehow AHEAD
+        # of the failed step (a later-step checkpoint resurfacing after a
+        # transient validation failure), replay starts past the poison
+        # already — never ask the cursor to rewind.
+        skip = max((failed_step + 1) - good_step, 0)
+        self.loader.load_state_dict(loader_state)
+        self.loader.skip_batches(skip)
+        stats.skipped_batches += skip
+        self._gnorm_ema = extra.get("gnorm_ema")
+        self._anomaly_run = 0
+        # Persist the advanced cursor AT the restored step immediately: a
+        # crash before the next periodic save would otherwise resume with
+        # the old cursor, replay the poison, and have to roll back again.
+        self.ckpt.save(
+            good_step, state, force=True, overwrite=True,
+            extra=self._ckpt_extra(),
+        )
+        log.warning(
+            "auto-rollback: restored step %d, skipping the %d-batch poison "
+            "window (data cursor offset now %d)",
+            good_step, skip, self.loader.offset,
+        )
+        return state, good_step
 
     # -- data -------------------------------------------------------------
 
@@ -681,18 +897,44 @@ class Trainer:
         self,
         state: Optional[TrainState] = None,
         preemption_handler: Optional[Any] = None,
+        restart_info: Optional[tuple] = None,
     ) -> list:
-        from orion_tpu.runtime.fault import Preempted, PreemptionHandler, Watchdog
+        """Run the step loop from the restored (or given) state.
+
+        ``restart_info=(attempt, reason)`` threads the supervisor context
+        (run_with_restarts) into the step log: the restart count rides the
+        metrics extras, the previous attempt's fault reason the log line.
+        """
+        from orion_tpu.runtime.fault import (
+            InjectedFault, Preempted, PreemptionHandler, Watchdog,
+        )
         import contextlib
 
         cfg = self.cfg
+        stats = self.robustness
+        if restart_info is not None:
+            attempt, reason = restart_info
+            stats.restarts = int(attempt)
+            if reason:
+                stats.last_fault_reason = str(reason)
+            if attempt:
+                log.warning(
+                    "supervisor restart %d: resuming after %s",
+                    attempt, reason or "unknown fault",
+                )
         if state is None:
             state, start = self.restore_or_init()
         else:
             start = int(jax.device_get(state["step"]))
+        guard = cfg.train.anomaly_guard
+        injector = self.fault_injector
         profile = cfg.train.profile_steps
         watch = metrics_lib.Stopwatch()
         tracing = False
+        # After an auto-rollback the replayed trajectory differs from the
+        # one the existing checkpoints captured; overwrite them up to the
+        # rollback point so a crash mid-replay resumes the NEW trajectory.
+        overwrite_until = -1
         try:
           with contextlib.ExitStack() as stack:
             # An externally-managed handler (tests, schedulers) is used
@@ -707,17 +949,33 @@ class Trainer:
                 Watchdog(cfg.train.watchdog_timeout_s,
                          action=cfg.train.watchdog_action)
             )
-            for step in range(start, cfg.train.num_steps):
+            step = start
+            while step < cfg.train.num_steps:
                 if cfg.train.inject_fault_at_step == step:
                     key = (cfg.checkpoint.directory, step)
                     if key not in _FIRED_FAULTS:
                         _FIRED_FAULTS.add(key)
                         raise FaultInjected(f"injected fault at step {step}")
+                if injector is not None \
+                        and injector.take("dispatch", step, "train"):
+                    raise InjectedFault(
+                        f"injected train dispatch fault at step {step}"
+                    )
                 if profile and step == profile[0]:
                     jax.profiler.start_trace(cfg.train.profile_dir)
                     tracing = True
                 batch = self.global_batch(step)
-                state, m = self.train_step(state, batch)
+                step_fn = self.train_step
+                if injector is not None \
+                        and injector.take("nan", step, "train") is not None:
+                    log.warning(
+                        "fault injection: NaN-poisoned train step %d", step
+                    )
+                    step_fn = self._poison_variant()
+                if guard:
+                    state, m = step_fn(state, batch, self._spike_limit())
+                else:
+                    state, m = step_fn(state, batch)
                 m = jax.device_get(m)
                 dt = watch.lap(sync_on=m["loss"])
                 watchdog.heartbeat()
@@ -725,6 +983,32 @@ class Trainer:
                     "ce_loss": float(m["ce_loss"]),
                     "moe_aux": float(m["moe_aux"]),
                 }
+                anomalous = bool(guard and m["anomaly"] > 0)
+                if guard:
+                    extras["anomaly"] = float(m["anomaly"])
+                    if anomalous:
+                        stats.anomalous_steps += 1
+                        stats.nonfinite_steps += int(m["nonfinite"] > 0)
+                        stats.spike_steps += int(m["spike"] > 0)
+                        self._anomaly_run += 1
+                        log.warning(
+                            "anomalous step %d skipped (%s; grad_norm %.3g; "
+                            "run %d/%d)", step,
+                            "non-finite" if m["nonfinite"] > 0
+                            else "norm spike",
+                            float(m["grad_norm"]), self._anomaly_run,
+                            cfg.train.anomaly_limit,
+                        )
+                    else:
+                        self._anomaly_run = 0
+                        beta = cfg.train.anomaly_ema_beta
+                        g = float(m["grad_norm"])
+                        self._gnorm_ema = (
+                            g if self._gnorm_ema is None
+                            else beta * self._gnorm_ema + (1 - beta) * g
+                        )
+                if stats.restarts or stats.rollbacks or stats.anomalous_steps:
+                    extras.update(stats.as_extras())
                 eval_iv = cfg.train.eval_interval
                 if eval_iv and (step + 1) % eval_iv == 0:
                     extras["eval_loss"] = self.evaluate(state["params"])
@@ -746,29 +1030,57 @@ class Trainer:
                 if tracing and step + 1 >= profile[1]:
                     jax.profiler.stop_trace()
                     tracing = False
+                if anomalous \
+                        and self._anomaly_run >= cfg.train.anomaly_limit:
+                    state, step = self._rollback(step)
+                    overwrite_until = self._overwrite_from(step)
+                    watch.lap()   # rollback time out of the next step's MFU
+                    continue
                 if self.ckpt is not None:
-                    self.ckpt.save(step + 1, state)
+                    self.ckpt.save(
+                        step + 1, state, extra=self._ckpt_extra(),
+                        overwrite=step + 1 <= overwrite_until,
+                    )
                 if preempt.preempted:
                     # Step boundary: state is consistent. Persist and stop
                     # cleanly; the supervisor restart resumes losslessly.
-                    if self.ckpt is not None:
-                        self.ckpt.save(step + 1, state, force=True)
+                    # The emergency save queues BEHIND any in-flight async
+                    # save (single writer queue) and wait() drains both
+                    # inside the grace window.
+                    if self.ckpt is not None and cfg.train.emergency_ckpt:
+                        if self.ckpt.save(
+                            step + 1, state, force=True,
+                            extra=self._ckpt_extra(),
+                            overwrite=step + 1 <= overwrite_until,
+                        ):
+                            stats.emergency_saves += 1
                         self.ckpt.wait()
                     raise Preempted(f"preempted after step {step + 1}")
+                step += 1
             if self.ckpt is not None:
-                self.ckpt.save(cfg.train.num_steps, state, force=True)
+                self.ckpt.save(
+                    cfg.train.num_steps, state, force=True,
+                    extra=self._ckpt_extra(),
+                )
             return self.metrics.history
-        except (KeyboardInterrupt, FaultInjected):
+        except (KeyboardInterrupt, FaultInjected, InjectedFault):
             # Preemption-safe path: persist the newest complete state, then
             # re-raise so a supervisor can restart and restore_or_init.
             # If the interrupt landed inside train_step, `state` is the
             # donated (deleted) input — in that case the last periodic
             # checkpoint stands and at most one step is lost.
-            if self.ckpt is not None:
+            if self.ckpt is not None and cfg.train.emergency_ckpt:
                 try:
-                    self.ckpt.save(
-                        int(jax.device_get(state["step"])), state, force=True
-                    )
+                    at_step = int(jax.device_get(state["step"]))
+                    if self.ckpt.save(
+                        at_step, state, force=True, extra=self._ckpt_extra(),
+                        # Inside a rollback-replay window the committed
+                        # checkpoint at this step captured the ABANDONED
+                        # trajectory; the emergency save must replace it or
+                        # the restart resumes the wrong stream.
+                        overwrite=at_step <= overwrite_until,
+                    ):
+                        stats.emergency_saves += 1
                 except RuntimeError:
                     log.warning(
                         "state was donated mid-step; relying on last "
@@ -782,3 +1094,12 @@ class Trainer:
             if self.ckpt is not None:
                 self.ckpt.wait()
             self.metrics.close()
+
+    def _overwrite_from(self, good_step: int) -> int:
+        """Newest committed step at rollback time: checkpoints in
+        (good_step, newest] captured the abandoned trajectory and are
+        overwritten as the replay passes them."""
+        if self.ckpt is None:
+            return -1
+        latest = self.ckpt.latest_step()
+        return latest if latest is not None else -1

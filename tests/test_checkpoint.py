@@ -1,62 +1,167 @@
-"""Checkpoint manager regressions (ckpt/checkpoint.py).
+"""Native checkpoint manager tier (ckpt/checkpoint.py, ISSUE 8).
 
-The stream-format stamp (round 5) records the data-stream mapping of the
-latest COMMITTED save. With async_save the stamp used to land only at the
-wait()/close() barrier — a long run that crashed mid-run left every
-committed checkpoint unstamped, and resume warned "written before round
-5" spuriously (ADVICE r5). save() now flushes the pending stamp at the
-start of the NEXT save once the prior async save has committed, bounding
-the stamp lag to one save interval.
+Manager-level contracts: atomic commit layout (temp dir -> manifest ->
+rename), save-interval/skip/force/overwrite semantics, async worker commit
++ stream-format stamping with no lag (the round-8 one-interval stamp lag
+is gone — the worker stamps immediately after each commit), retention GC,
+and manifest contents (per-array checksum/dtype/shape/sharding + step +
+extra host metadata). The corruption/fallback matrix and the trainer-level
+resume-equivalence suite live in tests/test_train_fault.py.
 """
 
 import json
 import os
 
+import jax
 import jax.numpy as jnp
-import pytest
+import numpy as np
 
-ocp = pytest.importorskip("orbax.checkpoint")
-
-from orion_tpu.ckpt import CheckpointManager          # noqa: E402
-from orion_tpu.config import CheckpointConfig         # noqa: E402
+from orion_tpu.ckpt import CheckpointManager
+from orion_tpu.config import CheckpointConfig
 
 
-def _state():
-    return {"a": jnp.arange(4, dtype=jnp.float32)}
+def _state(x=0.0):
+    return {
+        "a": jnp.arange(4, dtype=jnp.float32) + x,
+        "nested": {"b": jnp.ones((2, 3), jnp.int32)},
+    }
 
 
-def test_async_stamp_flushes_at_next_save(tmp_path):
+def _assert_tree_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_save_restore_roundtrip_with_extra(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, CheckpointConfig(async_save=False))
+    extra = {"loader": {"offset": 3}, "gnorm_ema": 0.125}
+    assert mgr.save(5, _state(1.0), force=True, extra=extra)
+    restored = mgr.restore_latest(_state())
+    assert restored is not None
+    state, step = restored
+    assert step == 5
+    _assert_tree_equal(state, _state(1.0))
+    assert mgr.last_restore_extra == extra
+    assert mgr.last_restore_step == 5
+    assert mgr.quarantined == []
+    mgr.close()
+
+
+def test_manifest_records_checksums_shapes_and_stream_state(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, CheckpointConfig(async_save=False))
+    mgr.save(2, _state(), force=True)
+    mpath = os.path.join(d, "step_00000002", "manifest.json")
+    manifest = json.load(open(mpath))
+    from orion_tpu.data.loader import STREAM_FORMAT
+
+    assert manifest["format"] == 1
+    assert manifest["step"] == 2
+    assert manifest["stream_format"] == STREAM_FORMAT
+    leaves = manifest["leaves"]
+    assert set(leaves) == {"['a']", "['nested']['b']"}
+    a = leaves["['a']"]
+    assert a["dtype"] == "float32" and a["shape"] == [4]
+    shard = a["shards"][0]
+    assert shard["nbytes"] == 16
+    path = os.path.join(d, "step_00000002", shard["file"])
+    import zlib
+
+    assert zlib.crc32(open(path, "rb").read()) == shard["crc32"]
+    mgr.close()
+
+
+def test_interval_skip_force_and_overwrite(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(
+        d, CheckpointConfig(async_save=False, save_interval_steps=10,
+                            max_to_keep=10)
+    )
+    assert not mgr.save(3, _state())          # interval not due
+    assert mgr.save(10, _state())             # due
+    assert not mgr.save(10, _state())         # already committed: skip
+    assert mgr.save(11, _state(), force=True)
+    assert mgr.latest_step() == 11
+    # Overwrite replaces the committed bytes (rollback replay path).
+    assert mgr.save(11, _state(7.0), force=True, overwrite=True)
+    state, step = mgr.restore_latest(_state())
+    assert step == 11
+    _assert_tree_equal(state["a"], _state(7.0)["a"])
+    mgr.close()
+
+
+def test_async_commit_stamps_without_lag(tmp_path):
+    """The async worker writes the stream-format stamp immediately after
+    each commit — a run that crashes between saves leaves every committed
+    checkpoint stamped (the round-8 fix flushed one interval late; now
+    there is no lag at all)."""
     d = str(tmp_path / "ck")
     mgr = CheckpointManager(d, CheckpointConfig(async_save=True))
     assert mgr.save(0, _state(), force=True)
-    # A NON-saving call (interval not due, no force) must not flush: the
-    # trainer calls save() every step, and flushing there would block the
-    # training loop on the async commit it exists to hide.
+    mgr.wait()                         # drain the worker; no close() yet
     stamp = os.path.join(d, "stream_format.json")
-    assert not mgr.save(1, _state())     # interval 1000: not due
-    assert getattr(mgr, "_stamp_pending", False)
-    # The first async save alone may not have stamped yet (commit is
-    # asynchronous; the stamp belongs to committed checkpoints only).
-    # The SECOND save must flush the first save's pending stamp before
-    # dispatching its own work — one save interval of lag, not the whole
-    # run.
-    assert mgr.save(1, _state(), force=True)
-    assert os.path.exists(stamp), "stamp not flushed by the next save()"
-    with open(stamp) as f:
-        saved = json.load(f)["stream_format"]
+    assert os.path.exists(stamp), "stamp missing after async commit"
     from orion_tpu.data.loader import STREAM_FORMAT
 
-    assert saved == STREAM_FORMAT
-    # The second save's own stamp is pending again, flushed at the
-    # wait()/close() barrier as before.
-    assert getattr(mgr, "_stamp_pending", False)
-    mgr.close()
+    assert json.load(open(stamp))["stream_format"] == STREAM_FORMAT
+    assert mgr.latest_step() == 0
     assert not getattr(mgr, "_stamp_pending", True)
+    # A non-committing save neither stalls nor stamps anything new.
+    assert not mgr.save(1, _state())
+    mgr.close()
 
 
-def test_sync_stamp_lands_inline(tmp_path):
+def test_retention_gc_keeps_newest(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(
+        d, CheckpointConfig(async_save=False, max_to_keep=2)
+    )
+    for s in (1, 2, 3, 4):
+        mgr.save(s, _state(float(s)), force=True)
+    steps = sorted(
+        int(n.split("_")[1]) for n in os.listdir(d) if n.startswith("step_")
+    )
+    assert steps == [3, 4]
+    mgr.close()
+
+
+def test_crashed_overwrite_restores_aside_copy(tmp_path):
+    """Overwrite is two-phase (dest moved aside before the new dir lands):
+    a crash between the two renames leaves step_N.replaced, which the next
+    manager restores — the step is never without an intact copy."""
     d = str(tmp_path / "ck")
     mgr = CheckpointManager(d, CheckpointConfig(async_save=False))
-    assert mgr.save(0, _state(), force=True)
-    assert os.path.exists(os.path.join(d, "stream_format.json"))
+    mgr.save(3, _state(3.0), force=True)
     mgr.close()
+    # Simulate the crash window: dest renamed aside, new dir never landed.
+    os.rename(os.path.join(d, "step_00000003"),
+              os.path.join(d, "step_00000003.replaced"))
+    mgr2 = CheckpointManager(d, CheckpointConfig(async_save=False))
+    state, step = mgr2.restore_latest(_state())
+    assert step == 3
+    _assert_tree_equal(state["a"], _state(3.0)["a"])
+    # And the other crash window: both present -> aside copy discarded.
+    os.makedirs(os.path.join(d, "step_00000003.replaced"))
+    mgr3 = CheckpointManager(d, CheckpointConfig(async_save=False))
+    assert not os.path.exists(os.path.join(d, "step_00000003.replaced"))
+    assert mgr3.latest_step() == 3
+    mgr3.close()
+
+
+def test_torn_tmp_dir_swept_on_init(tmp_path):
+    """A crash mid-save leaves a .tmp-* directory that was never renamed;
+    the next manager sweeps it and the committed set is untouched."""
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, CheckpointConfig(async_save=False))
+    mgr.save(1, _state(), force=True)
+    mgr.close()
+    torn = os.path.join(d, ".tmp-step_00000002")
+    os.makedirs(torn)
+    open(os.path.join(torn, "arr_00000.bin"), "wb").write(b"\x00" * 8)
+    mgr2 = CheckpointManager(d, CheckpointConfig(async_save=False))
+    assert not os.path.exists(torn)
+    assert mgr2.latest_step() == 1
+    state, step = mgr2.restore_latest(_state())
+    assert step == 1
+    mgr2.close()

@@ -406,9 +406,10 @@ def test_debug_asserts_catch_true_router_corruption():
 
 
 def test_checkpoint_stream_format_stamp(tmp_path, caplog):
-    """Checkpoints stamp the data-stream format (ADVICE r4): matching
-    formats restore silently; a mismatched or missing stamp warns that
-    resume replays a different token order."""
+    """Checkpoints record the data-stream format (ADVICE r4) — since
+    ISSUE 8 in the manifest itself (the sidecar stamp remains for
+    fleet-wide warnings): matching formats restore silently; a mismatched
+    manifest warns that resume replays a different token order."""
     import json
     import logging
     import os
@@ -418,26 +419,26 @@ def test_checkpoint_stream_format_stamp(tmp_path, caplog):
                                 "checkpoint.async_save=false"))
     t = Trainer(cfg)
     t.fit()
-    stamp = os.path.join(str(tmp_path) + "/ckpt", "stream_format.json")
+    ckdir = str(tmp_path) + "/ckpt"
+    stamp = os.path.join(ckdir, "stream_format.json")
     from orion_tpu.data.loader import STREAM_FORMAT
 
     assert json.load(open(stamp))["stream_format"] == STREAM_FORMAT
 
-    # Matching stamp: no stream-format warning on restore.
+    # Matching format: no stream-format warning on restore.
     with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
         Trainer(cfg).restore_or_init()
     assert not [r for r in caplog.records if "stream" in r.message]
     caplog.clear()
 
-    # Mismatched stamp warns loudly.
-    json.dump({"stream_format": 1}, open(stamp, "w"))
+    # A manifest written under an older stream format warns loudly.
+    newest = sorted(
+        d for d in os.listdir(ckdir) if d.startswith("step_")
+    )[-1]
+    mpath = os.path.join(ckdir, newest, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["stream_format"] = 1
+    json.dump(manifest, open(mpath, "w"))
     with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
         Trainer(cfg).restore_or_init()
     assert [r for r in caplog.records if "different token order" in r.message]
-    caplog.clear()
-
-    # Missing stamp (pre-round-5 checkpoint) warns too.
-    os.remove(stamp)
-    with caplog.at_level(logging.WARNING, logger="orion_tpu.ckpt"):
-        Trainer(cfg).restore_or_init()
-    assert [r for r in caplog.records if "no stream-format stamp" in r.message]

@@ -1,0 +1,549 @@
+"""Training-run fault tolerance (ISSUE 8) — the training-side twin of the
+round-11 serving-engine hardening, all on CPU:
+
+  - **Corruption matrix** (manager-level, fast): truncated array file, bad
+    checksum, missing/garbage manifest, missing array file, torn tmp dir,
+    schema mismatch — each resolving to a TYPED fallback
+    (CorruptCheckpoint.reason) onto the newest intact checkpoint, with the
+    damaged one quarantined.
+  - **Bitwise resume equivalence**: train 2N steps vs train N / fault /
+    restore / train N produce identical losses and final state — including
+    under FaultInjector dispatch/NaN/partial-write faults.
+  - **Gradient anomaly guard**: donation-safe skip (params/optimizer
+    byte-identical to pre-step), guard-off trace carries no finiteness ops,
+    and `train.anomaly_limit` consecutive anomalies trigger auto-rollback
+    with a data-cursor fast-forward past the poison window.
+
+Fast cases are tier-1; heavy compositions (preemption mid-run,
+run_with_restarts loops, accumulation x guard) are `slow` per the budget
+convention (ROADMAP).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ckpt import CheckpointManager, CorruptCheckpoint
+from orion_tpu.config import CheckpointConfig, get_config
+from orion_tpu.data import make_loader
+from orion_tpu.runtime.fault import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    Preempted,
+    PreemptionHandler,
+)
+from orion_tpu.train import Trainer, make_train_step
+from orion_tpu.train.trainer import RollbackFailed
+
+slow = pytest.mark.slow
+
+
+def _cfg(tmp_path=None, extra=(), sub="ckpt"):
+    over = [
+        "runtime.platform=cpu", "train.num_steps=12",
+        "optimizer.warmup_steps=2", "train.log_interval=1000",
+        "checkpoint.save_interval_steps=4",
+    ]
+    if tmp_path is not None:
+        over.append(f"checkpoint.directory={tmp_path}/{sub}")
+    return get_config("tiny", over + list(extra))
+
+
+def _tree_equal(a, b, equal_nan=False):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        la, lb = np.asarray(la), np.asarray(lb)
+        if equal_nan and np.issubdtype(la.dtype, np.floating):
+            np.testing.assert_array_equal(
+                np.nan_to_num(la, nan=1.25e9), np.nan_to_num(lb, nan=1.25e9)
+            )
+        else:
+            np.testing.assert_array_equal(la, lb)
+
+
+# ---------------------------------------------------------------------------
+# Corruption matrix (manager-level)
+# ---------------------------------------------------------------------------
+
+
+def _state(x=0.0):
+    return {
+        "w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + x,
+        "opt": {"count": jnp.asarray(int(x), jnp.int32)},
+    }
+
+
+def _seeded_mgr(tmp_path, steps=(1, 2, 3), injector=None):
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"),
+        CheckpointConfig(async_save=False, max_to_keep=10),
+        fault_injector=injector,
+    )
+    for s in steps:
+        mgr.save(s, _state(float(s)), force=True)
+    return mgr
+
+
+def _step_dir(mgr, step):
+    return os.path.join(mgr._dir, f"step_{step:08d}")
+
+
+def _bin_files(path):
+    return sorted(f for f in os.listdir(path) if f.endswith(".bin"))
+
+
+def _assert_falls_back(mgr, reason, to_step=2, corrupt_step=3):
+    restored = mgr.restore_latest(_state())
+    assert restored is not None
+    state, step = restored
+    assert step == to_step
+    _tree_equal(state, _state(float(to_step)))
+    assert (corrupt_step, reason) in mgr.quarantined
+
+
+def test_truncated_array_falls_back(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    d = _step_dir(mgr, 3)
+    f = os.path.join(d, _bin_files(d)[0])
+    with open(f, "r+b") as fh:
+        fh.truncate(os.path.getsize(f) // 2)
+    _assert_falls_back(mgr, "truncated_array")
+    # Quarantined, not deleted: the damaged dir moved aside with a typed
+    # reason file for the post-mortem.
+    q = os.path.join(mgr._dir, "quarantine", "step_00000003-truncated_array")
+    assert os.path.isdir(q)
+    assert json.load(open(os.path.join(q, "reason.json")))["reason"] \
+        == "truncated_array"
+
+
+def test_bad_checksum_falls_back(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    d = _step_dir(mgr, 3)
+    f = os.path.join(d, _bin_files(d)[0])
+    raw = bytearray(open(f, "rb").read())
+    raw[0] ^= 0xFF                      # same length, flipped bits
+    open(f, "wb").write(bytes(raw))
+    _assert_falls_back(mgr, "bad_checksum")
+
+
+def test_missing_manifest_falls_back(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    os.remove(os.path.join(_step_dir(mgr, 3), "manifest.json"))
+    _assert_falls_back(mgr, "missing_manifest")
+
+
+def test_garbage_manifest_falls_back(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    open(os.path.join(_step_dir(mgr, 3), "manifest.json"), "w").write("{nope")
+    _assert_falls_back(mgr, "bad_manifest")
+
+
+def test_missing_array_file_falls_back(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    d = _step_dir(mgr, 3)
+    os.remove(os.path.join(d, _bin_files(d)[0]))
+    _assert_falls_back(mgr, "missing_array")
+
+
+def test_schema_mismatch_excluded_without_quarantine(tmp_path):
+    """A leaf-set mismatch is a CONFIG problem, not corruption: the
+    checkpoint is excluded with a typed reason but left in place (moving
+    it aside on a config typo would destroy good checkpoints)."""
+    mgr = _seeded_mgr(tmp_path, steps=(1,))
+    restored = mgr.restore_latest({"different": jnp.zeros(2)})
+    assert restored is None
+    assert mgr.quarantined == [(1, "leaf_mismatch")]
+    assert os.path.isdir(_step_dir(mgr, 1))     # still there
+
+
+def test_multi_step_fallback_walks_to_oldest(tmp_path):
+    mgr = _seeded_mgr(tmp_path)
+    d3 = _step_dir(mgr, 3)
+    os.remove(os.path.join(d3, "manifest.json"))
+    d2 = _step_dir(mgr, 2)
+    f = os.path.join(d2, _bin_files(d2)[0])
+    with open(f, "r+b") as fh:
+        fh.truncate(1)
+    state, step = mgr.restore_latest(_state())
+    assert step == 1
+    _tree_equal(state, _state(1.0))
+    assert mgr.quarantined == [
+        (3, "missing_manifest"), (2, "truncated_array")
+    ]
+
+
+def test_all_corrupt_returns_none(tmp_path):
+    mgr = _seeded_mgr(tmp_path, steps=(1, 2))
+    for s in (1, 2):
+        os.remove(os.path.join(_step_dir(mgr, s), "manifest.json"))
+    assert mgr.restore_latest(_state()) is None
+    assert len(mgr.quarantined) == 2
+
+
+def test_partial_write_injection_detected(tmp_path):
+    """FaultSpec(kind="partial_write") tears the commit AFTER the
+    checksums land in the manifest — restore must checksum-detect it."""
+    inj = FaultInjector(specs=[FaultSpec(kind="partial_write", step=3)])
+    mgr = _seeded_mgr(tmp_path, injector=inj)
+    assert inj.fired == [("partial_write", 3, "ckpt")]
+    _assert_falls_back(mgr, "truncated_array")
+
+
+def test_agreement_helpers_single_process():
+    from orion_tpu.runtime.distributed import agree_all, agree_on_steps
+
+    assert agree_on_steps([3, 1, 2, 2]) == [1, 2, 3]
+    assert agree_all(True) and not agree_all(False)
+
+
+# ---------------------------------------------------------------------------
+# Gradient anomaly guard (step-level, eager — no donation in play)
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_guard_skip_is_bitwise_noop():
+    cfg = _cfg(extra=("train.anomaly_guard=true",))
+    t = Trainer(cfg)
+    step_fn = make_train_step(t.cfg, t._schedule, t.mesh)
+    state = t.init_state()
+    batch = t.global_batch(0)
+    # norm_limit 0: every finite step counts as a spike -> skipped.
+    new_state, m = step_fn(state, batch, np.float32(0.0))
+    assert float(m["anomaly"]) == 1.0 and float(m["spike"]) == 1.0
+    assert float(m["nonfinite"]) == 0.0
+    _tree_equal(new_state["params"], state["params"])
+    _tree_equal(new_state["opt"], state["opt"])       # count NOT advanced
+    assert int(new_state["step"]) == int(state["step"]) + 1
+
+
+def test_guard_on_clean_step_matches_guard_off_bitwise():
+    cfg_on = _cfg(extra=("train.anomaly_guard=true",))
+    t = Trainer(cfg_on)
+    guard_fn = make_train_step(t.cfg, t._schedule, t.mesh)
+    import dataclasses as _dc
+
+    cfg_off = _dc.replace(
+        t.cfg, train=_dc.replace(t.cfg.train, anomaly_guard=False)
+    )
+    plain_fn = make_train_step(cfg_off, t._schedule, t.mesh)
+    state = t.init_state()
+    batch = t.global_batch(0)
+    s_on, m_on = guard_fn(state, batch, np.float32(np.inf))
+    s_off, m_off = plain_fn(state, batch)
+    assert float(m_on["anomaly"]) == 0.0
+    _tree_equal(s_on, s_off)
+    assert float(m_on["loss"]) == float(m_off["loss"])
+
+
+def test_guard_off_trace_has_no_finiteness_ops():
+    """The guard-off compiled train step is the pre-guard program: no
+    is_finite / anomaly plumbing is ever staged unless the knob is on."""
+    t = Trainer(_cfg())
+    state = t.abstract_state()
+    batch = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+        t.global_batch(0),
+    )
+    txt_off = t._jit_step.lower(state, batch).as_text()
+    assert "is_finite" not in txt_off and "is-finite" not in txt_off
+
+    t_on = Trainer(_cfg(extra=("train.anomaly_guard=true",)))
+    limit = jax.ShapeDtypeStruct((), np.float32)
+    txt_on = t_on._jit_step.lower(state, batch, limit).as_text()
+    assert "is_finite" in txt_on or "is-finite" in txt_on
+
+
+def test_guard_keeps_donation_aliasing():
+    """The per-leaf where-selects must not break buffer donation: every
+    donated master/moment byte still aliases into the outputs (a leak
+    would double the step's footprint — memory_report raises if so)."""
+    t = Trainer(_cfg(extra=("train.anomaly_guard=true",)))
+    report = t.memory_report(assert_donation=True)
+    assert report["available"]
+    assert report["unaliased_donated_bytes"] == 0
+
+
+def test_guard_rejects_checkify():
+    with pytest.raises(ValueError, match="anomaly_guard"):
+        Trainer(_cfg(extra=("train.anomaly_guard=true",
+                            "runtime.checkify=true")))
+
+
+# ---------------------------------------------------------------------------
+# Data-loader cursor
+# ---------------------------------------------------------------------------
+
+
+def test_loader_cursor_state_roundtrip_and_skip():
+    cfg = _cfg()
+    loader = make_loader(cfg.data, cfg.model.vocab_size)
+    ref = make_loader(cfg.data, cfg.model.vocab_size)
+    b0 = ref.batch_at(2)
+    loader.skip_batches(2)
+    assert loader.state_dict()["offset"] == 2
+    _tree_equal(dict(loader.batch_at(0)), dict(b0))   # cursor shifts stream
+    with pytest.raises(ValueError, match="rewinds"):
+        loader.skip_batches(-1)
+    fresh = make_loader(cfg.data, cfg.model.vocab_size)
+    fresh.load_state_dict(loader.state_dict())
+    assert fresh.offset == 2
+    _tree_equal(dict(fresh.batch_at(5)), dict(loader.batch_at(5)))
+
+
+def test_loader_cursor_warns_on_stream_format_mismatch(caplog):
+    import logging
+
+    cfg = _cfg()
+    loader = make_loader(cfg.data, cfg.model.vocab_size)
+    with caplog.at_level(logging.WARNING, logger="orion_tpu.data"):
+        loader.load_state_dict({"offset": 1, "stream_format": 1})
+    assert [r for r in caplog.records
+            if "token order" in r.message.lower()]
+    assert loader.offset == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level resume equivalence + recovery (fast tier-1 cases)
+# ---------------------------------------------------------------------------
+
+
+def test_bitwise_resume_after_injected_dispatch_fault(tmp_path):
+    """Train 12 vs train-7/fault/emergency-save/restore/train-to-12:
+    losses and final state bitwise identical, with ASYNC saves (the
+    capture-copy path) on both runs."""
+    full = Trainer(_cfg(tmp_path, sub="cka")).fit()
+
+    inj = FaultInjector(
+        specs=[FaultSpec(kind="dispatch", step=7, path="train")]
+    )
+    with pytest.raises(InjectedFault):
+        Trainer(_cfg(tmp_path, sub="ckb"), fault_injector=inj).fit()
+    assert inj.fired == [("dispatch", 7, "train")]
+
+    t2 = Trainer(_cfg(tmp_path, sub="ckb"))
+    resumed = t2.fit()
+    assert resumed[0].step == 8        # emergency save landed at step 7
+    by_step = {m.step: m.loss for m in full}
+    for m in resumed:
+        assert m.loss == by_step[m.step], (m.step, m.loss)
+    ta = Trainer(_cfg(tmp_path, sub="cka"))
+    sa, _ = ta.ckpt.restore_latest(ta.abstract_state())
+    sb, _ = t2.ckpt.restore_latest(t2.abstract_state())
+    _tree_equal(sa, sb)
+
+
+def test_bitwise_resume_after_torn_final_save(tmp_path):
+    """A partial_write fault tears the FINAL checkpoint; a fresh trainer
+    quarantines it with a typed reason, restores the previous intact one,
+    and replays to a final state bitwise identical to the clean run."""
+    full_t = Trainer(_cfg(tmp_path, sub="cka"))
+    full = full_t.fit()
+
+    inj = FaultInjector(specs=[FaultSpec(kind="partial_write", step=12)])
+    Trainer(_cfg(tmp_path, sub="ckb"), fault_injector=inj).fit()
+    assert inj.fired == [("partial_write", 12, "ckpt")]
+
+    t2 = Trainer(_cfg(tmp_path, sub="ckb"))
+    resumed = t2.fit()                 # quarantines 12, resumes from 8
+    assert t2.robustness.corrupt_checkpoints == 1
+    assert t2.ckpt.quarantined == [(12, "truncated_array")]
+    assert resumed[0].step == 9
+    by_step = {m.step: m.loss for m in full}
+    for m in resumed:
+        assert m.loss == by_step[m.step], (m.step, m.loss)
+    sa, _ = full_t.ckpt.restore_latest(full_t.abstract_state())
+    sb, _ = t2.ckpt.restore_latest(t2.abstract_state())
+    _tree_equal(sa, sb)
+
+
+def test_nan_poison_rollback_and_cursor_fast_forward(tmp_path):
+    """Three consecutive NaN-poisoned steps (limit 3): each is skipped
+    with params intact, then auto-rollback restores the newest intact
+    checkpoint, fast-forwards the data cursor past the poison window, and
+    training recovers to a finite loss. The advanced cursor is persisted
+    at the restored step so a crash mid-replay cannot replay the poison."""
+    inj = FaultInjector(specs=[
+        FaultSpec(kind="nan", step=s, path="train") for s in (5, 6, 7)
+    ])
+    t = Trainer(
+        _cfg(tmp_path, extra=("train.anomaly_guard=true",
+                              "train.anomaly_limit=3")),
+        fault_injector=inj,
+    )
+    hist = t.fit()
+    stats = t.robustness
+    assert stats.anomalous_steps == 3
+    assert stats.nonfinite_steps == 3
+    assert stats.rollbacks == 1
+    assert stats.skipped_batches == 4      # restored step 4, failed step 7
+    assert t.loader.offset == 4
+    assert np.isfinite(hist[-1].loss)
+    assert hist[-1].step == 12
+    # The restored-step checkpoint was overwritten with the new cursor.
+    mgr = t.ckpt
+    state, step = mgr.restore_latest(t.abstract_state())
+    assert step == 12
+    assert mgr.last_restore_extra["loader"]["offset"] == 4
+    # Anomalous steps were logged (NaN loss) but never entered the params:
+    nan_steps = [m.step for m in hist if not np.isfinite(m.loss)]
+    assert nan_steps == [6, 7, 8]          # metrics log is 1-indexed
+
+
+def test_rollback_without_checkpoint_raises(tmp_path):
+    inj = FaultInjector(specs=[
+        FaultSpec(kind="nan", step=s, path="train") for s in (1, 2)
+    ])
+    t = Trainer(
+        _cfg(extra=("train.anomaly_guard=true", "train.anomaly_limit=2")),
+        fault_injector=inj,
+    )
+    with pytest.raises(RollbackFailed, match="no checkpoint"):
+        t.fit()
+
+
+# ---------------------------------------------------------------------------
+# Heavy compositions (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@slow
+def test_bitwise_resume_after_sigterm_preemption(tmp_path):
+    """SIGTERM inside the grace window: the PreemptionHandler flags, the
+    step boundary emergency-saves (awaiting the in-flight async save),
+    and the resumed run continues the identical trajectory bitwise."""
+    full = Trainer(_cfg(tmp_path, sub="cka")).fit()
+
+    class CountdownHandler(PreemptionHandler):
+        def __init__(self, after_checks):
+            super().__init__()
+            self._checks_left = after_checks
+
+        @property
+        def preempted(self):
+            self._checks_left -= 1
+            if self._checks_left <= 0:
+                self._flag.set()
+            return self._flag.is_set()
+
+    t = Trainer(_cfg(tmp_path, sub="ckb"))
+    handler = CountdownHandler(after_checks=7)
+    with pytest.raises(Preempted):
+        with handler:
+            t.fit(preemption_handler=handler)
+    assert t.robustness.emergency_saves == 1
+    assert t.ckpt.latest_step() == 7
+
+    t2 = Trainer(_cfg(tmp_path, sub="ckb"))
+    resumed = t2.fit()
+    by_step = {m.step: m.loss for m in full}
+    for m in resumed:
+        assert m.loss == by_step[m.step]
+    ta = Trainer(_cfg(tmp_path, sub="cka"))
+    sa, _ = ta.ckpt.restore_latest(ta.abstract_state())
+    sb, _ = t2.ckpt.restore_latest(t2.abstract_state())
+    _tree_equal(sa, sb)
+
+
+@slow
+def test_emergency_ckpt_off_skips_crash_save(tmp_path):
+    inj = FaultInjector(
+        specs=[FaultSpec(kind="dispatch", step=6, path="train")]
+    )
+    t = Trainer(
+        _cfg(tmp_path, extra=("train.emergency_ckpt=false",)),
+        fault_injector=inj,
+    )
+    with pytest.raises(InjectedFault):
+        t.fit()
+    # Only the periodic save at step 4 exists — no step-6 emergency save.
+    assert t.ckpt.latest_step() == 4
+    assert t.robustness.emergency_saves == 0
+
+
+@slow
+def test_run_with_restarts_with_injector_resumes_bitwise(tmp_path):
+    """The full supervisor story: dispatch fault -> emergency save ->
+    run_with_restarts rebuilds the trainer, threads the restart count and
+    fault reason into the step log, and the whole trajectory is bitwise
+    the uninterrupted one."""
+    from orion_tpu.runtime.fault import run_with_restarts
+
+    full = Trainer(_cfg(tmp_path, sub="cka")).fit()
+
+    inj = FaultInjector(
+        specs=[FaultSpec(kind="dispatch", step=9, path="train")]
+    )
+    last = {"reason": None}
+    trainers = []
+
+    def make_and_fit(attempt):
+        t = Trainer(_cfg(tmp_path, sub="ckb"), fault_injector=inj)
+        trainers.append(t)
+        return t.fit(restart_info=(attempt, last["reason"]))
+
+    def on_retry(attempt, exc):
+        last["reason"] = f"{type(exc).__name__}: {exc}"
+
+    hist = run_with_restarts(make_and_fit, max_restarts=2, on_retry=on_retry)
+    assert len(trainers) == 2
+    assert trainers[1].robustness.restarts == 1
+    assert "InjectedFault" in trainers[1].robustness.last_fault_reason
+    # The restarted attempt's metrics rows carry the restart count.
+    assert trainers[1].metrics.history[0].extras["restarts"] == 1.0
+    by_step = {m.step: m.loss for m in full}
+    for m in hist:
+        assert m.loss == by_step[m.step]
+
+
+@slow
+def test_guard_composes_with_grad_accum_bitwise_resume(tmp_path):
+    """anomaly_guard x grad_accum x async saves: NaN skip + resume still
+    bitwise-reproduce the same-faults uninterrupted trajectory."""
+    extra = ("train.anomaly_guard=true", "train.grad_accum=2")
+    inj_a = FaultInjector(
+        specs=[FaultSpec(kind="nan", step=5, path="train")]
+    )
+    full = Trainer(
+        _cfg(tmp_path, sub="cka", extra=extra), fault_injector=inj_a
+    ).fit()
+
+    inj_b = FaultInjector(specs=[
+        FaultSpec(kind="nan", step=5, path="train"),
+        FaultSpec(kind="dispatch", step=8, path="train"),
+    ])
+    with pytest.raises(InjectedFault):
+        Trainer(
+            _cfg(tmp_path, sub="ckb", extra=extra), fault_injector=inj_b
+        ).fit()
+    t2 = Trainer(_cfg(tmp_path, sub="ckb", extra=extra))
+    resumed = t2.fit()
+    by_step = {m.step: m.loss for m in full}
+    for m in resumed:
+        la, lb = m.loss, by_step[m.step]
+        assert la == lb or (np.isnan(la) and np.isnan(lb))
+    ta = Trainer(_cfg(tmp_path, sub="cka", extra=extra))
+    sa, _ = ta.ckpt.restore_latest(ta.abstract_state())
+    sb, _ = t2.ckpt.restore_latest(t2.abstract_state())
+    _tree_equal(sa, sb)
+
+
+@slow
+def test_train_fault_shim_deprecation():
+    import importlib
+    import sys
+    import warnings
+
+    sys.modules.pop("orion_tpu.train.fault", None)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        import orion_tpu.train.fault as shim
+
+        importlib.reload(shim)
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    from orion_tpu.runtime.fault import PreemptionHandler as canonical
+
+    assert shim.PreemptionHandler is canonical

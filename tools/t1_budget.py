@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Tier-1 time-budget watchdog (ISSUE 8 CI tooling).
+
+The tier-1 gate (ROADMAP.md) runs under a hard 870 s timeout and the suite
+has historically run close to it — a PR that quietly adds 60 s of tests
+only fails AFTER it lands, when the timeout kills the run. This tool makes
+the regression visible before it breaks the gate:
+
+    python tools/t1_budget.py /tmp/_t1.log            # parse an existing log
+    python tools/t1_budget.py /tmp/_t1.log --budget 870 --warn-frac 0.85
+
+It parses the pytest output for the total wall time and (when the run was
+invoked with ``--durations=N``) the slowest-test table, prints the top-20
+slowest tests and the total against the budget, and exits nonzero when the
+total exceeds the budget (or ``--warn-frac`` of it with ``--strict-warn``).
+
+Run the tier-1 command with ``--durations=25`` appended to get the
+per-test breakdown; without it the tool still checks the total.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+# "269 passed, 154 deselected in 344.61s (0:05:44)" (and failed/error forms)
+_SUMMARY_RE = re.compile(
+    r"(\d+ (?:passed|failed|error)[^\n]*?) in ([0-9.]+)s"
+)
+# "12.34s call     tests/test_x.py::test_y" (pytest --durations table)
+_DURATION_RE = re.compile(
+    r"^\s*([0-9.]+)s\s+(call|setup|teardown)\s+(\S+)", re.MULTILINE
+)
+
+
+def parse_log(text: str):
+    """Return (summary_line, total_seconds, [(seconds, phase, test), ...])."""
+    summary, total = None, None
+    for m in _SUMMARY_RE.finditer(text):
+        summary, total = m.group(1), float(m.group(2))  # last wins
+    durations = [
+        (float(s), phase, test)
+        for s, phase, test in _DURATION_RE.findall(text)
+    ]
+    durations.sort(reverse=True)
+    return summary, total, durations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("log", nargs="?", default="/tmp/_t1.log",
+                    help="tier-1 pytest log (default /tmp/_t1.log)")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="wall-time budget in seconds (default 870)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="how many slowest tests to print (default 20)")
+    ap.add_argument("--warn-frac", type=float, default=0.9,
+                    help="warn when total exceeds this fraction of budget")
+    ap.add_argument("--strict-warn", action="store_true",
+                    help="exit nonzero on the warn threshold too")
+    args = ap.parse_args(argv)
+
+    try:
+        text = open(args.log, errors="replace").read()
+    except OSError as e:
+        print(f"t1_budget: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+
+    summary, total, durations = parse_log(text)
+    if total is None:
+        print(f"t1_budget: no pytest summary line found in {args.log} "
+              f"(did the run finish?)", file=sys.stderr)
+        return 2
+
+    if durations:
+        print(f"top {min(args.top, len(durations))} slowest tests "
+              f"(of {len(durations)} timed phases):")
+        for secs, phase, test in durations[: args.top]:
+            print(f"  {secs:8.2f}s  {phase:<8s} {test}")
+        shown = sum(s for s, _, _ in durations[: args.top])
+        print(f"  {'':8s}   top-{args.top} sum: {shown:.1f}s")
+    else:
+        print("no --durations table in the log; append --durations=25 to "
+              "the tier-1 pytest command for the per-test breakdown")
+
+    frac = total / args.budget
+    print(f"\n{summary}")
+    print(f"total: {total:.1f}s of {args.budget:.0f}s budget "
+          f"({frac * 100:.1f}%)")
+    if total > args.budget:
+        print("t1_budget: OVER BUDGET — the tier-1 gate's timeout will "
+              "kill this suite", file=sys.stderr)
+        return 1
+    if frac > args.warn_frac:
+        print(f"t1_budget: WARNING — past {args.warn_frac * 100:.0f}% of "
+              f"budget; trim or slow-mark tests before the gate breaks",
+              file=sys.stderr)
+        return 1 if args.strict_warn else 0
+    print("t1_budget: within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,9 +24,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--list-presets", action="store_true")
     parser.add_argument("--print-config", action="store_true")
     parser.add_argument(
-        "--max-restarts", type=int, default=0,
+        "--max-restarts", type=int, default=None,
         help="supervisor mode: restart-and-resume after failures, up to N "
-             "times (resumes from the newest checkpoint)",
+             "times (resumes from the newest intact checkpoint); default "
+             "from train.max_restarts",
     )
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides, e.g. model.n_layers=4"
@@ -46,7 +47,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from orion_tpu.train import Trainer
 
-    if args.max_restarts > 0:
+    max_restarts = (
+        args.max_restarts if args.max_restarts is not None
+        else cfg.train.max_restarts
+    )
+    if max_restarts > 0:
         from orion_tpu.runtime.fault import run_with_restarts
 
         if not cfg.checkpoint.directory or not cfg.checkpoint.restore:
@@ -55,9 +60,20 @@ def main(argv: list[str] | None = None) -> int:
                 "checkpoint.restore=true): without it every restart would "
                 "silently retrain from step 0"
             )
+        # Thread the supervisor context into each attempt's step log:
+        # restart count in the metrics extras, the previous attempt's
+        # fault reason on the resume log line.
+        last_fault = {"reason": None}
+
+        def _on_retry(attempt, exc):
+            last_fault["reason"] = f"{type(exc).__name__}: {exc}"
+
         history = run_with_restarts(
-            lambda attempt: Trainer(cfg).fit(),
-            max_restarts=args.max_restarts,
+            lambda attempt: Trainer(cfg).fit(
+                restart_info=(attempt, last_fault["reason"])
+            ),
+            max_restarts=max_restarts,
+            on_retry=_on_retry,
         )
     else:
         history = Trainer(cfg).fit()
