@@ -227,6 +227,11 @@ def bench_infer(overrides, metric="llama_flagship_decode_tput") -> int:
         "device": dev.device_kind,
         "model": cfg.model.name,
     }
+    from orion_tpu.obs import bench_metrics_block
+
+    # Standard bench metrics block (ISSUE 9): registry gauges + the
+    # drained reset_timing window of the timed decode run.
+    result["metrics"] = bench_metrics_block(eng, timing=timing)
     print(json.dumps(result))
     return 0
 

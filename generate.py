@@ -55,6 +55,19 @@ def main(argv: list[str] | None = None) -> int:
                              "distribution; sugar for "
                              "inference.speculative=true + "
                              "inference.speculate_tokens=N")
+    parser.add_argument("--trace", metavar="PATH", default=None,
+                        help="export a Chrome trace-event JSON of the "
+                             "serve to PATH (request-lifecycle spans + "
+                             "per-dispatch timing; load in Perfetto); "
+                             "sugar for inference.trace=true + "
+                             "inference.trace_path=PATH")
+    parser.add_argument("--flight-dir", metavar="DIR", default=None,
+                        help="flight-recorder postmortem dumps: on a "
+                             "degradation trigger (watchdog stall, step "
+                             "faults, NaN quarantine, spec auto-disable) "
+                             "write the fault-adjacent span window to "
+                             "DIR; sugar for inference.flight_dir=DIR "
+                             "(render with tools/obs_report.py)")
     parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides"
     )
@@ -89,6 +102,11 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(f"--speculate must be >= 1, got {args.speculate}")
         overrides.append("inference.speculative=true")
         overrides.append(f"inference.speculate_tokens={args.speculate}")
+    if args.trace is not None:
+        overrides.append("inference.trace=true")
+        overrides.append(f"inference.trace_path={args.trace}")
+    if args.flight_dir is not None:
+        overrides.append(f"inference.flight_dir={args.flight_dir}")
     cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 
@@ -152,6 +170,16 @@ def main(argv: list[str] | None = None) -> int:
                               flush=True)
                 emitted = [len(r.generated) for r in reqs]
     engine.close()
+    if args.trace:
+        # Re-export explicitly so the success message reflects THIS run
+        # (a stale file from a previous serve must not mask a failure).
+        try:
+            engine.export_trace(args.trace)
+            print(f"trace written to {args.trace} (open in Perfetto, or "
+                  f"run tools/obs_report.py {args.trace})")
+        except OSError as e:
+            print(f"trace export to {args.trace} failed: {e}",
+                  file=sys.stderr)
     for i, (prompt, req) in enumerate(zip(prompts, reqs)):
         out = req.generated
         tag = "" if req.outcome == "completed" else f" [{req.outcome}]"

@@ -510,6 +510,26 @@ class TrainConfig:
     # trainer and resume from the newest intact checkpoint after a
     # recoverable failure, up to this many times. 0 = crash on first fault.
     max_restarts: int = 0
+    # --- Observability (orion_tpu/obs; README "Observability") ----------
+    # Per-step phase tracer: spans for data / dispatch / guard / ckpt per
+    # train step in a bounded monotonic-clock ring, exportable as Chrome
+    # trace-event JSON; the dispatch span rides a
+    # jax.profiler.StepTraceAnnotation so host phases line up with the
+    # device profile from the train.profile_steps window. Off by default
+    # (host path byte-identical to the untraced loop; compiled programs
+    # untouched either way).
+    trace: bool = False
+    trace_ring: int = 16384
+    # Chrome-trace export target, written when fit() ends. Setting it
+    # implies recording even when `trace` is off. None = record only.
+    trace_path: Optional[str] = None
+    # Flight recorder: postmortem-dump directory for the training-side
+    # trigger (anomaly auto-rollback). Setting it enables event recording
+    # even when `trace` is off. None disables.
+    flight_dir: Optional[str] = None
+    # Prometheus-textfile export of the trainer registry (last step
+    # metrics + robustness counters), rewritten every log_interval steps.
+    metrics_prom: Optional[str] = None
 
     def __post_init__(self):
         if self.anomaly_limit is None or self.anomaly_limit < 1:
@@ -530,6 +550,10 @@ class TrainConfig:
         if self.max_restarts is None or self.max_restarts < 0:
             raise ValueError(
                 f"train.max_restarts={self.max_restarts} must be >= 0"
+            )
+        if self.trace_ring is None or self.trace_ring < 1:
+            raise ValueError(
+                f"train.trace_ring={self.trace_ring} must be >= 1"
             )
 
 
@@ -677,6 +701,36 @@ class InferenceConfig:
     # train.watchdog_action="abort". A dispatch that errors rather than
     # stalls is the failed-step path, not this one. None disables.
     watchdog_timeout_s: Optional[float] = None
+    # --- Observability (orion_tpu/obs; README "Observability") ----------
+    # Request-lifecycle span tracer: submit/admit/first-token/outcome
+    # instants plus a span per device dispatch (prefill/decode/verify/
+    # mixed), recorded in a bounded monotonic-clock ring and exportable as
+    # Chrome trace-event JSON (Perfetto-loadable); dispatches also carry
+    # jax.profiler.TraceAnnotation so host spans align with a device
+    # profile captured over the same window. Off by default: the host path
+    # is byte-identical to the untraced engine (compiled programs are
+    # untouched in both modes).
+    trace: bool = False
+    # Ring capacity, in events (spans + instants). Bounds tracer memory on
+    # long-lived engines; the flight recorder dumps this ring's recent
+    # window.
+    trace_ring: int = 16384
+    # Export target for the Chrome trace (written by engine.close(), or on
+    # demand via engine.export_trace(path)). Setting it implies recording
+    # even when `trace` is off (a configured export target silently
+    # producing nothing would be a foot-gun). None = record only.
+    trace_path: Optional[str] = None
+    # Flight recorder (orion_tpu/obs/flight.py): directory for postmortem
+    # dumps auto-written when a degradation trigger fires — watchdog
+    # stall, max_step_faults, NaN quarantine, speculation auto-disable.
+    # Setting it also enables event recording (the dump needs a ring to
+    # dump) even when `trace` is off. None disables.
+    flight_dir: Optional[str] = None
+    # Metrics-registry exporters, driven from reset_timing's drain point:
+    # every drain appends one JSONL time-series row / rewrites one
+    # Prometheus textfile from the drained window + pool/HBM gauges.
+    metrics_jsonl: Optional[str] = None
+    metrics_prom: Optional[str] = None
 
     def __post_init__(self):
         # Domain checks only (each field alone), matching ModelConfig's
@@ -706,6 +760,10 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.watchdog_timeout_s={self.watchdog_timeout_s} "
                 f"must be > 0 (or none)"
+            )
+        if self.trace_ring is None or self.trace_ring < 1:
+            raise ValueError(
+                f"inference.trace_ring={self.trace_ring} must be >= 1"
             )
 
 

@@ -10,6 +10,7 @@ slots and KV pages free up; batching never changes any request's tokens
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -45,6 +46,12 @@ from orion_tpu.metrics import (
     PrefixCacheStats,
     RobustnessStats,
     SpecDecodeStats,
+)
+from orion_tpu.obs import (
+    MetricsRegistry,
+    export_chrome_safe,
+    init_obs,
+    live_hbm_metrics,
 )
 from orion_tpu.runtime.fault import (
     DispatchFault,
@@ -307,6 +314,24 @@ class InferenceEngine:
                 ),
             ).start()
 
+        # -- Observability (orion_tpu/obs; README "Observability") ---------
+        # Registry: always constructed (providers are lazy reads of live
+        # state — zero hot-path cost); tracer/flight only when asked for,
+        # so the untraced host path is byte-identical to the pre-obs
+        # engine.
+        self.registry = MetricsRegistry()
+        self._register_metrics()
+        self._tracer, self._flight = init_obs(
+            trace=self.icfg.trace,
+            trace_ring=self.icfg.trace_ring,
+            flight_dir=self.icfg.flight_dir,
+            trace_path=self.icfg.trace_path,
+            snapshot=self.registry.snapshot,
+            injector=self._injector,
+        )
+        self._ttft_seen: set[int] = set()   # rids with a first_token event
+        self._closed = False
+
         # Per-slot sampling params (inference.* defaults; submit() can
         # override per request, vLLM-style).
         self.slot_temp = np.full(self.max_batch, self.icfg.temperature,
@@ -401,6 +426,84 @@ class InferenceEngine:
                     "mixed_verify_defaults", self.mcfg, self.mesh
                 )
 
+    # -- observability (orion_tpu/obs) ------------------------------------
+
+    def _register_metrics(self) -> None:
+        """Wire the engine's live state into the metrics registry: the
+        per-window counters (timing/prefix/spec/robust — the same objects
+        reset_timing drains, read lazily so the registry always reports
+        the CURRENT window) plus the gauges the old reset_timing surface
+        never had: pool/prefix-tree occupancy and live HBM."""
+        reg = self.registry
+        reg.register("engine", lambda: {
+            **self.timing,
+            "decode_window": self.decode_window,
+            "step_no": self.step_no,
+            "waiting": len(self.waiting),
+            "active": sum(
+                1 for r in self.slots if r is not None and not r.done
+            ),
+            "preemptions": self.preemptions,
+        })
+        reg.register("robust", lambda: self.robust.as_timing())
+        if self.icfg.prefix_cache:
+            reg.register("prefix", lambda: self.prefix_stats.as_timing())
+        if self.icfg.speculative:
+            reg.register("spec", lambda: self.spec_stats.as_timing())
+        reg.register("pool", self._pool_metrics)
+        reg.register("hbm", live_hbm_metrics)
+
+    def _pool_metrics(self) -> dict:
+        """Page-pool and radix-tree occupancy gauges. ``occupancy`` counts
+        the usable pool (page 0 is the reserved scratch page); cached
+        pages are reclaimable headroom but still occupied."""
+        n = self.icfg.num_pages
+        usable = max(n - 1, 1)
+        free = self.alloc.free_pages
+        out = {
+            "num_pages": n,
+            "free_pages": free,
+            "occupancy": (usable - free) / usable,
+        }
+        if self._pcache is not None:
+            # held_pages() yields (it walks the radix tree); count it.
+            out["cached_pages"] = sum(1 for _ in self._pcache.held_pages())
+            out["evictable_pages"] = self._pcache.evictable_pages()
+        return out
+
+    @contextlib.contextmanager
+    def _device_span(self, path: str, bucket: str = "_dev_span"):
+        """The ONE dispatch-timing primitive every device call site shares
+        (previously four copy-pasted ``t_dev = time.perf_counter()``
+        blocks): wraps dispatch + token fetch, accumulating the elapsed
+        wall time into the step's device/prefill bucket and emitting a
+        tracer span over the same window. On an exception the bucket is
+        NOT credited (the pre-refactor behavior: a failed step's partial
+        span never lands in the timing split) but the tracer span still
+        records — a postmortem wants to see the dispatch that died."""
+        t0 = time.perf_counter()
+        with self._tracer.span("dispatch/" + path, step=self.step_no):
+            yield
+        setattr(self, bucket, getattr(self, bucket) + time.perf_counter() - t0)
+
+    def _flight_dump(self, reason: str, **context) -> None:
+        """Write a flight-recorder postmortem (no-op without
+        inference.flight_dir); best-effort — a failed dump degrades the
+        artifact, never the engine (FlightRecorder.try_dump)."""
+        if self._flight is not None:
+            self._flight.try_dump(reason, step=self.step_no, **context)
+
+    def _flight_note(self, kind: str, **fields) -> None:
+        """Stamp one event into the postmortem ring (no-op without
+        inference.flight_dir) — the single guard every fault path shares."""
+        if self._flight is not None:
+            self._flight.note(kind, step=self.step_no, **fields)
+
+    def export_trace(self, path: str) -> int:
+        """Export the span ring as Chrome trace-event JSON (Perfetto);
+        returns the number of events written (0 when tracing is off)."""
+        return self._tracer.export_chrome(path)
+
     # -- dispatch + degradation ladder ------------------------------------
 
     _PROGRAM_FNS = {
@@ -489,11 +592,19 @@ class InferenceEngine:
                 raise InjectedFault(
                     f"injected {path} dispatch fault (step {self.step_no})"
                 )
-            out = getattr(self, "_" + name)(*args)
-            jax.block_until_ready(out)
+            # TraceAnnotation (not a host-ring span — _device_span owns
+            # that window): names this dispatch in a concurrently-captured
+            # device profile so xprof rows align with the Chrome export.
+            with self._tracer.annotation("orion/" + path):
+                out = getattr(self, "_" + name)(*args)
+                jax.block_until_ready(out)
             return out
         except Exception as e:
             self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path=path,
+                error=f"{type(e).__name__}: {e}",
+            )
             if path in ("verify", "mixed_verify"):
                 # Degradation ladder rung 2 counts PRIMARY verify faults
                 # here — before the fallback — so a persistently broken
@@ -512,14 +623,16 @@ class InferenceEngine:
                 "reference path", path, type(e).__name__, e,
             )
             try:
-                out = fb(*args)
-                jax.block_until_ready(out)
+                with self._tracer.annotation("orion/" + path + "/fallback"):
+                    out = fb(*args)
+                    jax.block_until_ready(out)
             except Exception as e2:
                 self.robust.dispatch_faults += 1
                 raise DispatchFault(
                     path, f"xla fallback failed too: {e2}"
                 ) from e2
             self.robust.dispatch_fallbacks += 1
+            self._flight_note("dispatch_fallback", path=path)
             return out
 
     def _note_spec_fault(self, e: Exception) -> None:
@@ -544,6 +657,9 @@ class InferenceEngine:
             )
             log.error(
                 "speculative decoding %s", self.spec_stats.disabled_reason
+            )
+            self._flight_dump(
+                "spec_auto_disable", spec_faults=self._spec_faults
             )
 
     def _maybe_inject_nan(self) -> None:
@@ -604,6 +720,7 @@ class InferenceEngine:
         self.robust.quarantined += 1
         self._teardown_slot(req, 0)   # n_cached=0: donate nothing
         self._just_finished.append(req)
+        self._flight_dump(f"{reason}_quarantine", rid=req.rid)
 
     def _reap_expired(self) -> None:
         """Step-boundary deadline sweep: expired requests — waiting or
@@ -755,6 +872,13 @@ class InferenceEngine:
                 if deadline_s is not None else None
             ),
         )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "submit", rid=req.rid, priority=req.priority,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+                deadline_s=deadline_s,
+            )
         if self.draining:
             # Admission is stopped (SIGTERM drain): typed shed, never
             # queued — the caller still sees the request surface.
@@ -837,6 +961,7 @@ class InferenceEngine:
         tune the decode window from data rather than assertion.
         """
         t0 = time.perf_counter()
+        m0 = time.monotonic() if self._tracer.enabled else 0.0
         if self._watchdog is not None and self._watchdog.armed:
             # Refresh at step START so idle gaps between caller-driven
             # steps never read as stalls — only time INSIDE a step does.
@@ -878,7 +1003,15 @@ class InferenceEngine:
                 self.step_no, e, self._consec_failed,
                 self.icfg.max_step_faults,
             )
+            self._flight_note(
+                "failed_step", consecutive=self._consec_failed,
+                error=f"{type(e).__name__}: {e}",
+            )
             if self._consec_failed >= self.icfg.max_step_faults:
+                self._flight_dump(
+                    "max_step_faults",
+                    consecutive=self._consec_failed, error=str(e),
+                )
                 raise
             decoded = False
         total = time.perf_counter() - t0
@@ -920,7 +1053,36 @@ class InferenceEngine:
                 # process carries on, deadline expiry handles the SLO
                 # consequences at the next boundary.
                 self.robust.stalled_steps += 1
+                self._flight_dump("watchdog_stall", step_wall_s=total)
             self._watchdog.heartbeat()
+        if self._tracer.enabled:
+            # Request-lifecycle instants, swept at the step boundary where
+            # every token-emitting path has already run: first_token fires
+            # once per request (TTFT), outcome exactly once at the end.
+            # The wait queue is in the sweep too: a request preempted in
+            # the very step that produced its first token sits there, and
+            # skipping it would stamp its TTFT steps late.
+            for r in itertools.chain(
+                self.slots, self.waiting, self._just_finished
+            ):
+                if (
+                    r is not None and r.generated
+                    and r.rid not in self._ttft_seen
+                ):
+                    self._ttft_seen.add(r.rid)
+                    self._tracer.instant(
+                        "first_token", rid=r.rid, step=self.step_no
+                    )
+            for r in self._just_finished:
+                self._ttft_seen.discard(r.rid)
+                self._tracer.instant(
+                    "outcome", rid=r.rid, outcome=r.outcome,
+                    tokens=len(r.generated), step=self.step_no,
+                )
+            self._tracer.record_span(
+                "step", m0, time.monotonic(), step=self.step_no,
+                decoded=bool(decoded),
+            )
         self.step_no += 1
         done, self._just_finished = self._just_finished, []
         return done
@@ -975,6 +1137,23 @@ class InferenceEngine:
         # outcomes + fault episodes, always present.
         out.update(self.robust.as_timing())
         self.robust = RobustnessStats()
+        if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
+            # The registry exporters ride the drain point: one JSONL
+            # time-series row / one Prometheus textfile rewrite per drain
+            # window, carrying the drained counters plus the live gauges.
+            row = {f"serve.{k}": v for k, v in out.items()}
+            row.update(self.registry.snapshot(sections=("pool", "hbm")))
+            try:
+                if self.icfg.metrics_jsonl:
+                    self.registry.export_jsonl(
+                        self.icfg.metrics_jsonl, snapshot=row
+                    )
+                if self.icfg.metrics_prom:
+                    self.registry.export_prometheus(
+                        self.icfg.metrics_prom, snapshot=row
+                    )
+            except OSError as e:
+                log.error("metrics export failed: %s", e)
         return out
 
     def _autotune_window(self, step_total: float) -> None:
@@ -1061,7 +1240,18 @@ class InferenceEngine:
         return drained
 
     def close(self) -> None:
-        """Stop the serving watchdog thread (idempotent)."""
+        """Stop the serving watchdog thread, flush the metrics exporters
+        and export the Chrome trace when inference.trace_path is set.
+        Idempotent: the flush/export half runs once — a second close must
+        not append a spurious all-zero row to the metrics time series."""
+        if not self._closed:
+            self._closed = True
+            if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
+                # Final drain so a short-lived serve (the CLI path, which
+                # never calls reset_timing itself) still flushes its tail
+                # window through the exporters.
+                self.reset_timing()
+            export_chrome_safe(self._tracer, self.icfg.trace_path)
         if self._watchdog is not None:
             self._watchdog.stop()
 
@@ -1490,6 +1680,15 @@ class InferenceEngine:
                 self.waiting.appendleft(req)
                 break
             self.slots[slot] = req
+            if self._tracer.enabled:
+                self._tracer.instant(
+                    "admit", rid=req.rid, slot=slot, step=self.step_no,
+                    priority=req.priority,
+                    cached_tokens=(
+                        len(context) - 1 if full
+                        else n_match * self.psz
+                    ),
+                )
             icfg = self.icfg
             self.slot_temp[slot] = (
                 icfg.temperature if req.temperature is None
@@ -1600,31 +1799,30 @@ class InferenceEngine:
             pages[i, : len(tail_pg)] = [
                 0 if p is None else p for p in tail_pg
             ]
-        t0 = time.perf_counter()
-        try:
-            logits, self.cache = self._run_dispatch(
-                "prefill", "prefill",
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
-                jnp.asarray(pages),
-                jnp.asarray(pre_lens),
-                jnp.asarray(pre_pages),
-            )
-        except DispatchFault:
-            # Unwind this burst's admissions: their slots are claimed but
-            # NO KV was written, so tear down with nothing donated
-            # (n_cached=0 — donating would insert garbage pages into the
-            # prefix cache) and re-queue at the head for the next step's
-            # re-prefill.
-            for r in reversed(reqs):
-                self._teardown_slot(r, 0)
-                r.freed_until = 0
-                self.waiting.appendleft(r)
-            raise
-        firsts = self._sample(logits, reqs)   # blocks on the device fetch
-        self._prefill_span += time.perf_counter() - t0
+        with self._device_span("prefill", "_prefill_span"):
+            try:
+                logits, self.cache = self._run_dispatch(
+                    "prefill", "prefill",
+                    self.params,
+                    self.cache,
+                    jnp.asarray(tokens),
+                    jnp.asarray(lengths),
+                    jnp.asarray(pages),
+                    jnp.asarray(pre_lens),
+                    jnp.asarray(pre_pages),
+                )
+            except DispatchFault:
+                # Unwind this burst's admissions: their slots are claimed
+                # but NO KV was written, so tear down with nothing donated
+                # (n_cached=0 — donating would insert garbage pages into
+                # the prefix cache) and re-queue at the head for the next
+                # step's re-prefill.
+                for r in reversed(reqs):
+                    self._teardown_slot(r, 0)
+                    r.freed_until = 0
+                    self.waiting.appendleft(r)
+                raise
+            firsts = self._sample(logits, reqs)  # blocks on the fetch
         for i, req in enumerate(reqs):
             if req.max_new_tokens <= 0:
                 req.done = True   # prefill-only (scoring) request
@@ -1855,27 +2053,26 @@ class InferenceEngine:
             jnp.asarray(mask),
             sub,
         )
-        t_dev = time.perf_counter()
-        if all(
-            r.temperature is None and r.top_k is None and r.top_p is None
-            for r in active
-        ):
-            out = self._run_dispatch("verify", "verify_defaults", *common)
-        else:
-            out = self._run_dispatch(
-                "verify", "verify", *common,
-                jnp.asarray(self.slot_temp),
-                jnp.asarray(self.slot_top_k),
-                jnp.asarray(self.slot_top_p),
-            )
-        if self._guard:
-            acc, alt, ok, self.cache = out
-            acc, alt, okh = jax.device_get((acc, alt, ok))   # ONE fetch
-        else:
-            acc, alt, self.cache = out
-            acc, alt = jax.device_get((acc, alt))   # ONE fetch
-            okh = None
-        self._dev_span += time.perf_counter() - t_dev
+        with self._device_span("verify"):
+            if all(
+                r.temperature is None and r.top_k is None and r.top_p is None
+                for r in active
+            ):
+                out = self._run_dispatch("verify", "verify_defaults", *common)
+            else:
+                out = self._run_dispatch(
+                    "verify", "verify", *common,
+                    jnp.asarray(self.slot_temp),
+                    jnp.asarray(self.slot_top_k),
+                    jnp.asarray(self.slot_top_p),
+                )
+            if self._guard:
+                acc, alt, ok, self.cache = out
+                acc, alt, okh = jax.device_get((acc, alt, ok))  # ONE fetch
+            else:
+                acc, alt, self.cache = out
+                acc, alt = jax.device_get((acc, alt))   # ONE fetch
+                okh = None
         self.timing["slot_steps"] += len(active)
         if okh is not None:
             for req in active:
@@ -1977,28 +2174,27 @@ class InferenceEngine:
             jnp.asarray(mask),
             jax.random.split(sub, W),
         )
-        t_dev = time.perf_counter()
-        if all(
-            r.temperature is None and r.top_k is None and r.top_p is None
-            for r in active
-        ):
-            out = self._run_dispatch("decode", "decode_defaults", *common)
-        else:
-            out = self._run_dispatch(
-                "decode", "decode", *common,
-                jnp.asarray(self.slot_temp),
-                jnp.asarray(self.slot_top_k),
-                jnp.asarray(self.slot_top_p),
-            )
-        if self._guard:
-            toks, ok, self.cache = out
-            tokens, okh = jax.device_get((toks, ok))   # ONE fetch
-            tokens = np.asarray(tokens)
-        else:
-            toks, self.cache = out
-            tokens = np.asarray(jax.device_get(toks))  # [W, B], ONE fetch
-            okh = None
-        self._dev_span += time.perf_counter() - t_dev
+        with self._device_span("decode"):
+            if all(
+                r.temperature is None and r.top_k is None and r.top_p is None
+                for r in active
+            ):
+                out = self._run_dispatch("decode", "decode_defaults", *common)
+            else:
+                out = self._run_dispatch(
+                    "decode", "decode", *common,
+                    jnp.asarray(self.slot_temp),
+                    jnp.asarray(self.slot_top_k),
+                    jnp.asarray(self.slot_top_p),
+                )
+            if self._guard:
+                toks, ok, self.cache = out
+                tokens, okh = jax.device_get((toks, ok))   # ONE fetch
+                tokens = np.asarray(tokens)
+            else:
+                toks, self.cache = out
+                tokens = np.asarray(jax.device_get(toks))  # [W, B], ONE fetch
+                okh = None
         self.timing["slot_steps"] += W * len(active)
         if okh is not None:
             for req in active:
@@ -2166,23 +2362,23 @@ class InferenceEngine:
                 jnp.asarray(mask),
                 sub,
             ) + chunk_args
-            t_dev = time.perf_counter()
-            if defaults:
-                out = self._run_dispatch(
-                    "mixed_verify", "mixed_verify_defaults", *common
-                )
-            else:
-                out = self._run_dispatch(
-                    "mixed_verify", "mixed_verify", *common, *override_args
-                )
-            if self._guard:
-                acc, alt, ok, p_logits, self.cache = out
-                acc, alt, okh = jax.device_get((acc, alt, ok))  # ONE fetch
-            else:
-                acc, alt, p_logits, self.cache = out
-                acc, alt = jax.device_get((acc, alt))   # ONE fetch
-                okh = None
-            self._dev_span += time.perf_counter() - t_dev
+            with self._device_span("mixed_verify"):
+                if defaults:
+                    out = self._run_dispatch(
+                        "mixed_verify", "mixed_verify_defaults", *common
+                    )
+                else:
+                    out = self._run_dispatch(
+                        "mixed_verify", "mixed_verify", *common,
+                        *override_args
+                    )
+                if self._guard:
+                    acc, alt, ok, p_logits, self.cache = out
+                    acc, alt, okh = jax.device_get((acc, alt, ok))  # 1 fetch
+                else:
+                    acc, alt, p_logits, self.cache = out
+                    acc, alt = jax.device_get((acc, alt))   # ONE fetch
+                    okh = None
         else:
             common = (
                 self.params,
@@ -2193,22 +2389,23 @@ class InferenceEngine:
                 jnp.asarray(mask),
                 sub,
             ) + chunk_args
-            t_dev = time.perf_counter()
-            if defaults:
-                out = self._run_dispatch("mixed", "mixed_defaults", *common)
-            else:
-                out = self._run_dispatch(
-                    "mixed", "mixed", *common, *override_args
-                )
-            if self._guard:
-                d_toks, ok, p_logits, self.cache = out
-                d_out, okh = jax.device_get((d_toks, ok))   # ONE fetch
-                d_out = np.asarray(d_out)
-            else:
-                d_toks, p_logits, self.cache = out
-                d_out = np.asarray(jax.device_get(d_toks))  # [B], ONE fetch
-                okh = None
-            self._dev_span += time.perf_counter() - t_dev
+            with self._device_span("mixed"):
+                if defaults:
+                    out = self._run_dispatch(
+                        "mixed", "mixed_defaults", *common
+                    )
+                else:
+                    out = self._run_dispatch(
+                        "mixed", "mixed", *common, *override_args
+                    )
+                if self._guard:
+                    d_toks, ok, p_logits, self.cache = out
+                    d_out, okh = jax.device_get((d_toks, ok))   # ONE fetch
+                    d_out = np.asarray(d_out)
+                else:
+                    d_toks, p_logits, self.cache = out
+                    d_out = np.asarray(jax.device_get(d_toks))  # [B], 1 fetch
+                    okh = None
         real = sum(k for _, k in chunks)
         self.timing["mixed_steps"] += 1
         self.timing["prefill_chunks"] += len(chunks)

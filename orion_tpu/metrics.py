@@ -4,7 +4,10 @@ The judged metric is tokens/sec/chip + MFU for Llama-3-8B (BASELINE.json:2);
 this module owns that math (SURVEY.md §6 "Metrics / logging"): MFU = achieved
 model FLOP/s ÷ (chips × peak bf16 FLOP/s), with model FLOPs from the
 6·N·tokens estimate plus the attention term (ModelConfig.flops_per_token).
-Sinks: console, JSONL, and in-memory history for tests.
+Sinks: console, JSONL, and in-memory history for tests. The Stats
+dataclasses below double as metrics-registry providers (orion_tpu/obs/
+registry.py): their as_timing()/summary() dicts are what the registry
+snapshots and the Prometheus/JSONL exporters serialize.
 """
 
 from __future__ import annotations
@@ -245,6 +248,21 @@ class TrainRobustnessStats:
             "anomalous_steps": float(self.anomalous_steps),
             "rollbacks": float(self.rollbacks),
             "restarts": float(self.restarts),
+        }
+
+    def as_timing(self) -> dict[str, Any]:
+        """The FULL counter set, for the metrics registry / Prometheus
+        export (as_extras keeps its lean step-log subset)."""
+        return {
+            "anomalous_steps": self.anomalous_steps,
+            "nonfinite_steps": self.nonfinite_steps,
+            "spike_steps": self.spike_steps,
+            "rollbacks": self.rollbacks,
+            "skipped_batches": self.skipped_batches,
+            "emergency_saves": self.emergency_saves,
+            "corrupt_checkpoints": self.corrupt_checkpoints,
+            "restarts": self.restarts,
+            "last_fault_reason": self.last_fault_reason or "",
         }
 
 

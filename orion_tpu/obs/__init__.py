@@ -1,0 +1,36 @@
+"""Observability: span tracing, flight recording, metrics registry.
+
+The serving engine and the trainer both thread through this package
+(ISSUE 9): ``Tracer`` is the host-side span/event ring (Chrome trace-event
+export, ``jax.profiler`` annotation passthrough for device-profile
+alignment), ``FlightRecorder`` the bounded postmortem ring that auto-dumps
+on degradation triggers, and ``MetricsRegistry`` the named-snapshot surface
+unifying the per-subsystem Stats dataclasses (metrics.py) with pool
+occupancy and live-HBM gauges, exportable as Prometheus textfiles and
+JSONL time series.
+"""
+
+from orion_tpu.obs.flight import FlightRecorder, init_obs
+from orion_tpu.obs.registry import (
+    MetricsRegistry,
+    bench_metrics_block,
+    live_hbm_metrics,
+)
+from orion_tpu.obs.trace import (
+    NULL_TRACER,
+    NullTracer,
+    Tracer,
+    export_chrome_safe,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "NullTracer",
+    "Tracer",
+    "bench_metrics_block",
+    "export_chrome_safe",
+    "init_obs",
+    "live_hbm_metrics",
+]

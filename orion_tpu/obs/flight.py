@@ -1,0 +1,173 @@
+"""Flight recorder: bounded postmortem ring + auto-dump (ISSUE 9).
+
+Every degradation the fault-tolerance layers (PR 6/7) can survive —
+watchdog stall, consecutive step faults, NaN quarantine, speculation
+auto-disable, training anomaly rollback — now ships a postmortem artifact:
+a JSON dump of the fault-adjacent window of tracer spans, the recorder's
+own engine-event ring (dispatch faults, fallbacks, preemptions, injected
+faults), and a metrics snapshot, written to ``inference.flight_dir`` /
+``train.flight_dir`` at the moment the trigger fires. The dump is what
+``tools/obs_report.py`` renders into a terminal timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from orion_tpu.obs.trace import (
+    NULL_TRACER,
+    NullTracer,
+    Tracer,
+    serialize_events,
+)
+
+log = logging.getLogger("orion_tpu.obs")
+
+
+class FlightRecorder:
+    """Bounded ring of engine events riding a (possibly shared) tracer.
+
+    ``note(kind, **fields)`` appends to the event ring (cheap; called from
+    fault paths only, never per token). ``dump(reason, **context)`` writes
+    one self-contained JSON artifact:
+
+      - ``reason`` / ``context``: why this dump exists (the trigger).
+      - ``spans``: the tracer ring's recent window (``window_s`` seconds
+        back from the dump — the fault-adjacent timeline).
+      - ``events``: the recorder's own ring (faults, fallbacks, notes).
+      - ``metrics``: the registry snapshot at dump time, when a
+        ``snapshot`` callable was provided.
+
+    Dumps are best-effort: a full disk must degrade the postmortem, never
+    the serving/training process (callers catch OSError).
+    """
+
+    def __init__(
+        self,
+        tracer: Tracer | NullTracer,
+        directory: str,
+        capacity: int = 2048,
+        window_s: float = 60.0,
+        snapshot: Optional[Callable[[], dict]] = None,
+        min_interval_s: float = 10.0,
+        max_dumps: int = 256,
+    ):
+        self.tracer = tracer
+        self.directory = directory
+        self.window_s = window_s
+        self._events: deque[dict] = deque(maxlen=capacity)
+        self._snapshot = snapshot
+        self.dumps: list[str] = []   # paths written, oldest first
+        # Dump throttle: per-occurrence triggers (a watchdog stall fires
+        # every stalled step of a persistently slow engine; one poisoned
+        # step can quarantine N requests) must not turn a long incident
+        # into an unbounded stream of multi-MB writes inside the step
+        # loop. Repeats of a reason within min_interval_s are counted,
+        # not written; max_dumps caps the recorder's lifetime disk use.
+        self.min_interval_s = min_interval_s
+        self.max_dumps = max_dumps
+        self.throttled = 0           # dumps suppressed by the throttle
+        self._last_dump: dict[str, float] = {}   # reason -> monotonic t
+
+    def note(self, kind: str, **fields) -> None:
+        """Record one engine event in the postmortem ring (and as a tracer
+        instant, so it also lands in the Chrome timeline)."""
+        self._events.append(
+            {"t": time.monotonic(), "kind": kind, **fields}
+        )
+        self.tracer.instant(kind, **fields)
+
+    def dump(self, reason: str, **context) -> Optional[str]:
+        """Write the postmortem artifact; returns its path, or None when
+        the throttle suppressed it (same reason within ``min_interval_s``,
+        or ``max_dumps`` lifetime cap reached — suppressions are counted
+        in ``throttled``). File names carry the reason and a nanosecond
+        stamp, so repeated triggers in one process never clobber each
+        other."""
+        now = time.monotonic()
+        last = self._last_dump.get(reason)
+        if (last is not None and now - last < self.min_interval_s) \
+                or len(self.dumps) >= self.max_dumps:
+            self.throttled += 1
+            return None
+        self._last_dump[reason] = now
+        os.makedirs(self.directory, exist_ok=True)
+        spans = serialize_events([
+            e for e in self.tracer.events()
+            if e[3] >= now - self.window_s
+        ])
+        doc: dict[str, Any] = {
+            "reason": reason,
+            "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "t_dump": now,
+            "window_s": self.window_s,
+            "context": context,
+            "events": list(self._events),
+            "spans": spans,
+        }
+        if self._snapshot is not None:
+            try:
+                doc["metrics"] = self._snapshot()
+            except Exception as e:   # a metrics read must never kill a dump
+                doc["metrics"] = {"error": f"{type(e).__name__}: {e}"}
+        path = os.path.join(
+            self.directory, f"flight_{reason}_{time.time_ns()}.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            # default=str: a non-primitive tag/metric value (np scalars
+            # from user-registered providers) must degrade to its repr,
+            # never TypeError out of a postmortem write.
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+        self.dumps.append(path)
+        log.error("flight recorder: %s -> %s", reason, path)
+        return path
+
+    def try_dump(self, reason: str, **context) -> Optional[str]:
+        """``dump`` with the degradation contract applied: ANY failure to
+        write the artifact (full disk, permissions, a pathological value)
+        is logged and swallowed — the engine/trainer the recorder is
+        observing must never die of its own postmortem."""
+        try:
+            return self.dump(reason, **context)
+        except Exception as e:
+            log.error("flight recorder dump failed (%s): %s", reason, e)
+            return None
+
+
+def init_obs(
+    *,
+    trace: bool,
+    trace_ring: int,
+    flight_dir: Optional[str],
+    trace_path: Optional[str] = None,
+    snapshot: Optional[Callable[[], dict]] = None,
+    injector: Optional[Any] = None,
+):
+    """The ONE obs wiring both the engine and the trainer share: build the
+    tracer (NULL only when NOTHING asks for recording — a configured
+    ``trace_path`` or ``flight_dir`` implies recording even with the
+    ``trace`` flag off, since an export/dump needs a ring to read; a bare
+    trace_path silently producing no file would be a foot-gun), the
+    flight recorder, and hook a FaultInjector's ``on_fire`` observer so
+    injected faults land in the postmortem ring. Returns
+    ``(tracer, flight_or_None)``."""
+    obs_on = trace or trace_path is not None or flight_dir is not None
+    tracer = Tracer(capacity=trace_ring) if obs_on else NULL_TRACER
+    flight = None
+    if flight_dir is not None:
+        flight = FlightRecorder(tracer, flight_dir, snapshot=snapshot)
+        if injector is not None and injector.on_fire is None:
+            injector.on_fire = (
+                lambda kind, step, path, fl=flight: fl.note(
+                    "injected_fault", fault=kind, step=step,
+                    path=path or "",
+                )
+            )
+    return tracer, flight

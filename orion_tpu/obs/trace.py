@@ -1,0 +1,259 @@
+"""Low-overhead host-side span tracer (ISSUE 9 tentpole).
+
+A monotonic-clock ring buffer of spans and instant events. Design
+constraints, in order:
+
+  1. **~Zero cost when disabled.** Callers hold a ``NULL_TRACER`` whose
+     every method is a no-op returning a shared null context manager — no
+     clock reads, no allocation, no branch beyond the attribute lookup.
+     Compiled programs are never touched in either mode: the tracer is
+     pure host-side bookkeeping around dispatches, not inside them.
+  2. **Bounded.** The ring is a ``deque(maxlen=capacity)``; a serving
+     engine that runs for a week holds the most recent ``capacity``
+     events, which is exactly what the flight recorder wants to dump when
+     something degrades.
+  3. **Profiler-aligned.** ``annotation()`` / ``step_annotation()`` wrap
+     ``jax.profiler.TraceAnnotation`` / ``StepTraceAnnotation`` so host
+     spans emitted around device dispatches land in the SAME xprof
+     timeline as the device trace captured by ``train.profile_steps`` —
+     line the Chrome export up with the device profile by name.
+
+Export is Chrome trace-event JSON (``export_chrome``), loadable in
+Perfetto / ``chrome://tracing``; timestamps are microseconds relative to
+tracer construction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Optional
+
+import jax
+
+# Event tuples in the ring: (kind, name, t_start, t_end, tags) with kind
+# "span" (t_end > t_start) or "instant" (t_end == t_start). Times are
+# time.monotonic() seconds — wall-clock jumps (NTP) must never produce
+# negative spans in a postmortem artifact.
+Event = tuple[str, str, float, float, dict]
+
+
+class _NullCtx:
+    """Shared reusable no-op context manager (the disabled-tracer span)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class NullTracer:
+    """The disabled tracer: every call is a no-op. One shared instance
+    (``NULL_TRACER``) serves every disabled engine/trainer, so the
+    tracing-off host path is today's code plus one attribute lookup and
+    a no-op ``with`` per dispatch."""
+
+    enabled = False
+
+    def span(self, name: str, annotate: bool = False, **tags) -> _NullCtx:
+        return _NULL_CTX
+
+    def instant(self, name: str, **tags) -> None:
+        return None
+
+    def record_span(self, name: str, t_start: float, t_end: float,
+                    **tags) -> None:
+        return None
+
+    def annotation(self, name: str) -> _NullCtx:
+        return _NULL_CTX
+
+    def step_annotation(self, name: str, step: int) -> _NullCtx:
+        return _NULL_CTX
+
+    def events(self) -> list[Event]:
+        return []
+
+    def export_chrome(self, path: str) -> int:
+        return 0
+
+    def clear(self) -> None:
+        return None
+
+
+NULL_TRACER = NullTracer()
+
+
+class _Span:
+    """One live span: context manager that stamps monotonic start/end and
+    appends to the owning tracer's ring on exit (exit always records —
+    a span interrupted by an exception is exactly the span a postmortem
+    wants to see)."""
+
+    __slots__ = ("_tracer", "name", "tags", "t0", "t1", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, annotate: bool,
+                 tags: dict):
+        self._tracer = tracer
+        self.name = name
+        self.tags = tags
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self._ann = (
+            jax.profiler.TraceAnnotation(name) if annotate else None
+        )
+
+    def __enter__(self) -> "_Span":
+        if self._ann is not None:
+            self._ann.__enter__()
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.t1 = time.monotonic()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._tracer._ring.append(
+            ("span", self.name, self.t0, self.t1, self.tags)
+        )
+        return False
+
+    @property
+    def elapsed(self) -> float:
+        return self.t1 - self.t0
+
+
+class Tracer:
+    """The enabled tracer: bounded ring of spans + instants.
+
+    Thread-notes: ``deque.append`` is atomic under the GIL and the
+    watchdog/async-checkpoint threads only ever ``instant()``, so no lock
+    is needed on the hot path; ``events()`` snapshots with ``list()``.
+    """
+
+    enabled = True
+
+    def __init__(self, capacity: int = 16384):
+        if capacity < 1:
+            raise ValueError(f"tracer capacity={capacity} must be >= 1")
+        self.capacity = capacity
+        self._ring: deque[Event] = deque(maxlen=capacity)
+        self.t0 = time.monotonic()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, annotate: bool = False, **tags) -> _Span:
+        """Context manager recording a [enter, exit) span. With
+        ``annotate``, also enters a ``jax.profiler.TraceAnnotation`` of
+        the same name so the span shows up in a concurrently-captured
+        device profile (train.profile_steps window)."""
+        return _Span(self, name, annotate, tags)
+
+    def instant(self, name: str, **tags) -> None:
+        t = time.monotonic()
+        self._ring.append(("instant", name, t, t, tags))
+
+    def record_span(self, name: str, t_start: float, t_end: float,
+                    **tags) -> None:
+        """Append an already-measured span (times on the time.monotonic
+        clock) — for call sites that cannot wrap their body in a ``with``
+        without restructuring (e.g. the engine's whole-step span)."""
+        self._ring.append(("span", name, t_start, t_end, tags))
+
+    def annotation(self, name: str):
+        """Bare ``jax.profiler.TraceAnnotation`` context (device-profile
+        alignment only; records nothing in the host ring)."""
+        return jax.profiler.TraceAnnotation(name)
+
+    def step_annotation(self, name: str, step: int):
+        """``jax.profiler.StepTraceAnnotation`` context: marks a train
+        step boundary in the device profile, so xprof's step view lines
+        up with the host spans recorded around the same dispatch."""
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+    # -- reading / export --------------------------------------------------
+
+    def events(self) -> list[Event]:
+        """Snapshot of the ring, oldest first."""
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def export_chrome(self, path: str) -> int:
+        """Write the ring as Chrome trace-event JSON (Perfetto /
+        chrome://tracing loadable); returns the number of events written.
+        Spans are "X" (complete) events, instants "i"; ``ts``/``dur`` are
+        microseconds relative to tracer construction; tags ride ``args``.
+        """
+        evs: list[dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "orion-tpu host"}},
+        ]
+        base = self.t0
+        for kind, name, t_start, t_end, tags in self.events():
+            ev: dict[str, Any] = {
+                "name": name,
+                "ts": (t_start - base) * 1e6,
+                "pid": 0,
+                "tid": 0,
+                "args": dict(tags),
+            }
+            if kind == "span":
+                ev["ph"] = "X"
+                ev["dur"] = (t_end - t_start) * 1e6
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            evs.append(ev)
+        # tmp + atomic rename, like every other obs artifact writer: a
+        # poller watching trace_path (or a mid-write crash) must never see
+        # a torn multi-MB JSON. default=str: a non-primitive tag value
+        # degrades to its repr, never TypeErrors a shutdown-path export.
+        import os
+
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"traceEvents": evs, "displayTimeUnit": "ms"}, f,
+                default=str,
+            )
+        os.replace(tmp, path)
+        return len(evs) - 1  # metadata event excluded
+
+
+def export_chrome_safe(tracer, path: Optional[str]) -> int:
+    """Chrome export with the shared error contract (engine.close and
+    Trainer.fit both end with this): no-op when tracing is off or no path
+    is configured, and an export failure is logged, never raised — a full
+    disk must not fail a clean shutdown. Returns events written."""
+    import logging
+
+    log = logging.getLogger("orion_tpu.obs")
+    if not path or not tracer.enabled:
+        return 0
+    try:
+        n = tracer.export_chrome(path)
+        log.info("exported %d trace events to %s (load in Perfetto)",
+                 n, path)
+        return n
+    except OSError as e:
+        log.error("trace export to %s failed: %s", path, e)
+        return 0
+
+
+def serialize_events(events: list[Event]) -> list[dict[str, Any]]:
+    """Ring events as JSON-ready dicts (the flight-recorder dump format;
+    times stay monotonic seconds so dump consumers can window on them)."""
+    return [
+        {"kind": kind, "name": name, "t_start": t_start, "t_end": t_end,
+         "dur_ms": (t_end - t_start) * 1e3, **({"tags": tags} if tags else {})}
+        for kind, name, t_start, t_end, tags in events
+    ]

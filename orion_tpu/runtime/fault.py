@@ -355,10 +355,16 @@ class FaultInjector:
     ``fired`` so tests can assert the episode actually happened. The
     injector never mutates engine state itself — every fault manifests
     through the same code path a real failure would take.
+
+    ``on_fire(kind, step, path)`` is an optional observer invoked whenever
+    a spec is consumed: the engine/trainer wire it to the flight recorder
+    (orion_tpu/obs) so every injected fault is stamped into the postmortem
+    ring alongside the real fault events it provokes.
     """
 
     specs: list = field(default_factory=list)
     fired: list = field(default_factory=list)
+    on_fire: Optional[Callable[[str, int, Optional[str]], None]] = None
 
     def take(
         self, kind: str, step: int, path: Optional[str] = None
@@ -372,5 +378,10 @@ class FaultInjector:
             ):
                 s.count -= 1
                 self.fired.append((kind, step, path))
+                if self.on_fire is not None:
+                    try:
+                        self.on_fire(kind, step, path)
+                    except Exception:
+                        log.exception("FaultInjector on_fire observer failed")
                 return s
         return None

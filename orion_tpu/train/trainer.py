@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -637,6 +638,39 @@ class Trainer:
             log_interval=cfg.train.log_interval,
         )
         self.tokens_per_step = tokens_per_step
+        # -- Observability (orion_tpu/obs; README "Observability") ---------
+        # Registry always exists (lazy provider reads — no hot-path cost);
+        # tracer/flight only when train.trace / train.flight_dir ask, so
+        # the untraced fit loop is byte-identical to the pre-obs one.
+        from orion_tpu.obs import MetricsRegistry, init_obs, live_hbm_metrics
+
+        self.registry = MetricsRegistry()
+        self.registry.register(
+            "robust", lambda: self.robustness.as_timing()
+        )
+        self.registry.register("train", self._last_step_metrics)
+        self.registry.register("hbm", live_hbm_metrics)
+        self._tracer, self._flight = init_obs(
+            trace=cfg.train.trace,
+            trace_ring=cfg.train.trace_ring,
+            flight_dir=cfg.train.flight_dir,
+            trace_path=cfg.train.trace_path,
+            snapshot=self.registry.snapshot,
+            injector=fault_injector,
+        )
+
+    def _last_step_metrics(self) -> dict:
+        """Registry provider: the newest StepMetrics row (the same dict
+        the JSONL sink writes), or {} before the first step."""
+        h = self.metrics.history
+        return h[-1].to_dict() if h else {}
+
+    def _flight_dump(self, reason: str, **context) -> None:
+        """Write a flight-recorder postmortem (no-op without
+        train.flight_dir); best-effort like the engine's
+        (FlightRecorder.try_dump)."""
+        if self._flight is not None:
+            self._flight.try_dump(reason, **context)
 
     def _batch_sharding(self) -> NamedSharding:
         shard = batch_sharding(self.mesh)
@@ -804,6 +838,12 @@ class Trainer:
             f"anomaly_rollback: {self._anomaly_run} consecutive anomalous "
             f"steps ending at step {failed_step}"
         )
+        # Postmortem BEFORE the restore mutates loader/EMA state: the dump
+        # captures the poisoned window as the rollback saw it.
+        self._flight_dump(
+            "anomaly_rollback", failed_step=failed_step,
+            anomaly_run=self._anomaly_run,
+        )
         if self.ckpt is None:
             raise RollbackFailed(
                 f"{self._anomaly_run} consecutive anomalous steps at step "
@@ -964,7 +1004,9 @@ class Trainer:
                 if profile and step == profile[0]:
                     jax.profiler.start_trace(cfg.train.profile_dir)
                     tracing = True
-                batch = self.global_batch(step)
+                s0 = time.monotonic() if self._tracer.enabled else 0.0
+                with self._tracer.span("data", step=step):
+                    batch = self.global_batch(step)
                 step_fn = self.train_step
                 if injector is not None \
                         and injector.take("nan", step, "train") is not None:
@@ -972,11 +1014,17 @@ class Trainer:
                         "fault injection: NaN-poisoned train step %d", step
                     )
                     step_fn = self._poison_variant()
-                if guard:
-                    state, m = step_fn(state, batch, self._spike_limit())
-                else:
-                    state, m = step_fn(state, batch)
-                m = jax.device_get(m)
+                # StepTraceAnnotation marks the step boundary in a device
+                # profile captured over the same window (profile_steps),
+                # so xprof's step view lines up with the host spans; the
+                # dispatch span covers compiled-step call + metric fetch.
+                with self._tracer.step_annotation("train", step), \
+                        self._tracer.span("dispatch", step=step):
+                    if guard:
+                        state, m = step_fn(state, batch, self._spike_limit())
+                    else:
+                        state, m = step_fn(state, batch)
+                    m = jax.device_get(m)
                 dt = watch.lap(sync_on=m["loss"])
                 watchdog.heartbeat()
                 extras = {
@@ -985,28 +1033,29 @@ class Trainer:
                 }
                 anomalous = bool(guard and m["anomaly"] > 0)
                 if guard:
-                    extras["anomaly"] = float(m["anomaly"])
-                    if anomalous:
-                        stats.anomalous_steps += 1
-                        stats.nonfinite_steps += int(m["nonfinite"] > 0)
-                        stats.spike_steps += int(m["spike"] > 0)
-                        self._anomaly_run += 1
-                        log.warning(
-                            "anomalous step %d skipped (%s; grad_norm %.3g; "
-                            "run %d/%d)", step,
-                            "non-finite" if m["nonfinite"] > 0
-                            else "norm spike",
-                            float(m["grad_norm"]), self._anomaly_run,
-                            cfg.train.anomaly_limit,
-                        )
-                    else:
-                        self._anomaly_run = 0
-                        beta = cfg.train.anomaly_ema_beta
-                        g = float(m["grad_norm"])
-                        self._gnorm_ema = (
-                            g if self._gnorm_ema is None
-                            else beta * self._gnorm_ema + (1 - beta) * g
-                        )
+                    with self._tracer.span("guard", step=step):
+                        extras["anomaly"] = float(m["anomaly"])
+                        if anomalous:
+                            stats.anomalous_steps += 1
+                            stats.nonfinite_steps += int(m["nonfinite"] > 0)
+                            stats.spike_steps += int(m["spike"] > 0)
+                            self._anomaly_run += 1
+                            log.warning(
+                                "anomalous step %d skipped (%s; grad_norm "
+                                "%.3g; run %d/%d)", step,
+                                "non-finite" if m["nonfinite"] > 0
+                                else "norm spike",
+                                float(m["grad_norm"]), self._anomaly_run,
+                                cfg.train.anomaly_limit,
+                            )
+                        else:
+                            self._anomaly_run = 0
+                            beta = cfg.train.anomaly_ema_beta
+                            g = float(m["grad_norm"])
+                            self._gnorm_ema = (
+                                g if self._gnorm_ema is None
+                                else beta * self._gnorm_ema + (1 - beta) * g
+                            )
                 if stats.restarts or stats.rollbacks or stats.anomalous_steps:
                     extras.update(stats.as_extras())
                 eval_iv = cfg.train.eval_interval
@@ -1030,16 +1079,41 @@ class Trainer:
                 if tracing and step + 1 >= profile[1]:
                     jax.profiler.stop_trace()
                     tracing = False
+                if cfg.train.metrics_prom and \
+                        (step + 1) % max(cfg.train.log_interval, 1) == 0:
+                    try:
+                        self.registry.export_prometheus(
+                            cfg.train.metrics_prom
+                        )
+                    except OSError as e:
+                        log.error("metrics_prom export failed: %s", e)
                 if anomalous \
                         and self._anomaly_run >= cfg.train.anomaly_limit:
+                    if self._tracer.enabled:
+                        # Close the step span BEFORE the rollback's
+                        # `continue` — the anomalous step a postmortem
+                        # inspects must not be a hole in the timeline.
+                        self._tracer.record_span(
+                            "train_step", s0, time.monotonic(), step=step,
+                            anomalous=True,
+                        )
                     state, step = self._rollback(step)
                     overwrite_until = self._overwrite_from(step)
                     watch.lap()   # rollback time out of the next step's MFU
                     continue
                 if self.ckpt is not None:
-                    self.ckpt.save(
-                        step + 1, state, extra=self._ckpt_extra(),
-                        overwrite=step + 1 <= overwrite_until,
+                    # ckpt span: async saves enqueue here (the host-side
+                    # snapshot copy), sync saves block — either cost lands
+                    # in this phase of the timeline.
+                    with self._tracer.span("ckpt", step=step):
+                        self.ckpt.save(
+                            step + 1, state, extra=self._ckpt_extra(),
+                            overwrite=step + 1 <= overwrite_until,
+                        )
+                if self._tracer.enabled:
+                    self._tracer.record_span(
+                        "train_step", s0, time.monotonic(), step=step,
+                        anomalous=anomalous,
                     )
                 if preempt.preempted:
                     # Step boundary: state is consistent. Persist and stop
@@ -1094,6 +1168,14 @@ class Trainer:
             if self.ckpt is not None:
                 self.ckpt.wait()
             self.metrics.close()
+            from orion_tpu.obs import export_chrome_safe
+
+            export_chrome_safe(self._tracer, cfg.train.trace_path)
+            if cfg.train.metrics_prom:
+                try:
+                    self.registry.export_prometheus(cfg.train.metrics_prom)
+                except OSError as e:
+                    log.error("metrics_prom export failed: %s", e)
 
     def _overwrite_from(self, good_step: int) -> int:
         """Newest committed step at rollback time: checkpoints in

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Render a tracer export / flight-recorder dump as a terminal timeline
+summary (ISSUE 9 satellite; the serving-side companion of
+profile_report.py).
+
+Accepts either artifact the obs layer writes:
+
+  - a Chrome trace-event JSON (``inference.trace_path`` /
+    ``train.trace_path`` / ``engine.export_trace``), or
+  - a flight-recorder dump (``inference.flight_dir`` /
+    ``train.flight_dir`` auto-dumps on degradation triggers).
+
+Reports: span groups by total time (the slowest-spans table), the top
+individual spans, a per-request TTFT breakdown (submit -> admit queue
+wait vs admit -> first-token compute, from the lifecycle instants), and —
+for flight dumps — the fault-adjacent event window that explains why the
+dump exists.
+
+    python tools/obs_report.py /tmp/serve_trace.json
+    python tools/obs_report.py /tmp/flight/flight_nan_quarantine_*.json
+    python tools/obs_report.py --compare base_trace.json new_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+
+def load(path: str):
+    """Normalize either artifact into (spans, instants, meta):
+    spans [(name, t_start_s, dur_s, tags)], instants [(name, t_s, tags)],
+    meta {} for traces / the dump header for flight dumps."""
+    with open(path) as f:
+        doc = json.load(f)
+    spans, instants = [], []
+    if isinstance(doc, dict) and "spans" in doc and "reason" in doc:
+        # Flight-recorder dump: times are monotonic seconds.
+        for e in doc["spans"]:
+            tags = e.get("tags", {})
+            if e["kind"] == "span":
+                spans.append(
+                    (e["name"], e["t_start"], e["t_end"] - e["t_start"], tags)
+                )
+            else:
+                instants.append((e["name"], e["t_start"], tags))
+        meta = {k: doc.get(k) for k in
+                ("reason", "wall_time", "context", "events", "metrics")}
+        return spans, instants, meta
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    for e in events:
+        ph = e.get("ph")
+        tags = e.get("args", {})
+        if ph == "X":
+            spans.append(
+                (e["name"], e["ts"] / 1e6, e.get("dur", 0) / 1e6, tags)
+            )
+        elif ph == "i":
+            instants.append((e["name"], e["ts"] / 1e6, tags))
+    return spans, instants, {}
+
+
+def group_spans(spans):
+    """name -> dict(count, total_s, max_s)."""
+    groups: dict = collections.defaultdict(
+        lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0}
+    )
+    for name, _t, dur, _tags in spans:
+        g = groups[name]
+        g["count"] += 1
+        g["total_s"] += dur
+        g["max_s"] = max(g["max_s"], dur)
+    return dict(groups)
+
+
+def print_groups(groups, top: int) -> None:
+    total = sum(g["total_s"] for g in groups.values()) or 1e-12
+    print(f"{'span group':<28s} {'count':>7s} {'total':>9s} {'mean':>9s} "
+          f"{'max':>9s} {'share':>7s}")
+    ranked = sorted(
+        groups.items(), key=lambda kv: kv[1]["total_s"], reverse=True
+    )
+    for name, g in ranked[:top]:
+        mean = g["total_s"] / g["count"]
+        print(f"{name:<28s} {g['count']:>7d} {g['total_s'] * 1e3:>8.1f}ms "
+              f"{mean * 1e3:>8.2f}ms {g['max_s'] * 1e3:>8.2f}ms "
+              f"{g['total_s'] / total * 100:>6.1f}%")
+
+
+def print_slowest(spans, top: int) -> None:
+    print(f"\nslowest {min(top, len(spans))} individual spans:")
+    for name, t, dur, tags in sorted(
+        spans, key=lambda s: s[2], reverse=True
+    )[:top]:
+        extra = " ".join(
+            f"{k}={v}" for k, v in tags.items() if k in ("step", "rid")
+        )
+        print(f"  {dur * 1e3:>9.2f}ms  {name:<24s} {extra}")
+
+
+def ttft_breakdown(instants, top: int) -> None:
+    """Per-request lifecycle: submit -> admit (queue wait) -> first_token
+    (prefill/compute) -> outcome, from the engine's lifecycle instants."""
+    by_rid: dict = collections.defaultdict(dict)
+    for name, t, tags in instants:
+        rid = tags.get("rid")
+        if rid is None:
+            continue
+        if name in ("submit", "admit", "first_token"):
+            by_rid[rid].setdefault(name, t)   # first occurrence wins
+        elif name == "outcome":
+            by_rid[rid]["outcome"] = tags.get("outcome", "?")
+            by_rid[rid]["tokens"] = tags.get("tokens", 0)
+    if not by_rid:
+        return
+    print(f"\nper-request TTFT breakdown ({len(by_rid)} requests):")
+    print(f"  {'rid':>5s} {'queue':>9s} {'compute':>9s} {'ttft':>9s} "
+          f"{'tokens':>7s}  outcome")
+    rows = []
+    for rid, ev in by_rid.items():
+        sub, adm, first = (
+            ev.get("submit"), ev.get("admit"), ev.get("first_token")
+        )
+        ttft = (first - sub) if (first is not None and sub is not None) \
+            else None
+        rows.append((ttft if ttft is not None else -1.0, rid, sub, adm,
+                     first, ev))
+    for ttft, rid, sub, adm, first, ev in sorted(rows, reverse=True)[:top]:
+        fmt = lambda a, b: (
+            f"{(b - a) * 1e3:>8.2f}ms" if a is not None and b is not None
+            else f"{'-':>9s}"
+        )
+        print(f"  {rid:>5d} {fmt(sub, adm)} {fmt(adm, first)} "
+              f"{fmt(sub, first)} {ev.get('tokens', 0):>7} "
+              f" {ev.get('outcome', '(live)')}")
+
+
+def print_fault_window(meta, tail: int = 12) -> None:
+    print(f"\nflight dump: reason={meta['reason']} at {meta['wall_time']}")
+    if meta.get("context"):
+        print(f"  context: {json.dumps(meta['context'])}")
+    events = meta.get("events") or []
+    if events:
+        print(f"  last {min(tail, len(events))} recorder events:")
+        for e in events[-tail:]:
+            fields = {k: v for k, v in e.items() if k not in ("t", "kind")}
+            print(f"    t={e['t']:.3f}  {e['kind']:<18s} "
+                  f"{json.dumps(fields) if fields else ''}")
+    metrics = meta.get("metrics") or {}
+    faults = {
+        k: v for k, v in metrics.items()
+        if any(s in k for s in ("fault", "failed", "stalled", "quarantined",
+                                "shed", "expired", "rollback", "anomalous"))
+        and v not in (0, 0.0, "")
+    }
+    if faults:
+        print("  nonzero fault counters at dump time:")
+        for k in sorted(faults):
+            print(f"    {k} = {faults[k]}")
+
+
+def compare(path_a: str, path_b: str, top: int) -> int:
+    ga = group_spans(load(path_a)[0])
+    gb = group_spans(load(path_b)[0])
+    ta = sum(g["total_s"] for g in ga.values()) or 1e-12
+    tb = sum(g["total_s"] for g in gb.values()) or 1e-12
+    names = set(ga) | set(gb)
+    rows = []
+    for n in names:
+        sa = ga.get(n, {"total_s": 0.0})["total_s"] / ta
+        sb = gb.get(n, {"total_s": 0.0})["total_s"] / tb
+        rows.append((abs(sb - sa), n, sa, sb))
+    print(f"span-share diff: A={path_a}  B={path_b}")
+    print(f"{'span group':<28s} {'A share':>8s} {'B share':>8s} "
+          f"{'delta':>8s}")
+    for _d, n, sa, sb in sorted(rows, reverse=True)[:top]:
+        print(f"{n:<28s} {sa * 100:>7.1f}% {sb * 100:>7.1f}% "
+              f"{(sb - sa) * 100:>+7.1f}%")
+    print(f"\ntotal span time: A {ta * 1e3:.1f}ms -> B {tb * 1e3:.1f}ms "
+          f"({tb / ta:.2f}x)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    help="trace JSON or flight dump (2 with --compare)")
+    ap.add_argument("--compare", action="store_true",
+                    help="diff span shares between two artifacts")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        if len(args.paths) != 2:
+            print("--compare needs exactly two paths", file=sys.stderr)
+            return 2
+        return compare(args.paths[0], args.paths[1], args.top)
+    if len(args.paths) != 1:
+        print("one artifact at a time (or --compare A B)", file=sys.stderr)
+        return 2
+    spans, instants, meta = load(args.paths[0])
+    print(f"{args.paths[0]}: {len(spans)} spans, {len(instants)} instants")
+    if meta:
+        print_fault_window(meta)
+    if spans:
+        print("\nspan groups by total time:")
+        print_groups(group_spans(spans), args.top)
+        print_slowest(spans, min(args.top, 10))
+    ttft_breakdown(instants, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

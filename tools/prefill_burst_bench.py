@@ -73,6 +73,8 @@ def main() -> int:
             t = eng.reset_timing()   # the admit step only
             while eng.has_work():
                 eng.step()       # drain so the next burst admits cleanly
+        from orion_tpu.obs import bench_metrics_block
+
         print(json.dumps({
             "burst": name,
             "lengths": lengths,
@@ -85,6 +87,9 @@ def main() -> int:
             "device_ms": round(t["device_s"] * 1e3, 2),
             "host_ms": round(t["host_s"] * 1e3, 2),
             "tokens": int(sum(lengths)),
+            # Standard bench metrics block (ISSUE 9): registry gauges +
+            # the admit-step reset_timing window.
+            "metrics": bench_metrics_block(eng, timing=t),
         }))
     return 0
 

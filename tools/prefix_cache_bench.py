@@ -112,6 +112,8 @@ def main() -> int:
             admit_ms = (time.perf_counter() - t0) * 1e3
             t = eng.reset_timing()
             _drain(eng)
+            from orion_tpu.obs import bench_metrics_block
+
             print(json.dumps({
                 "phase": phase,
                 "shared_frac": frac,
@@ -122,6 +124,9 @@ def main() -> int:
                 "prefix_hits": int(t.get("prefix_hits", 0)),
                 "cached_tokens": int(t.get("cached_tokens", 0)),
                 "hit_rate": round(float(t.get("prefix_hit_rate", 0.0)), 3),
+                # Standard bench metrics block (ISSUE 9): registry gauges
+                # + the admit-step reset_timing window.
+                "metrics": bench_metrics_block(eng, timing=t),
             }))
     return 0
 

@@ -44,6 +44,7 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
     long prompt is injected (so its prefill provably lands mid-decode).
     """
     from orion_tpu.metrics import LatencyStats
+    from orion_tpu.obs import bench_metrics_block
 
     # Structural probe: the widest whole-prompt prefill dispatch issued
     # while at least one admitted request was decoding (chunked mode never
@@ -63,8 +64,10 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
     eng._prefill = counting
     itl = LatencyStats()
     max_chunk_step_tokens = 0
+    totals: dict = {}
     eng.reset_timing()
 
+    t_run0 = time.perf_counter()
     rids = [eng.submit(p, short_new) for p in shorts]
     reqs = {r.rid: r for r in eng.waiting}
     last_accept = {}
@@ -83,6 +86,17 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
         now = time.perf_counter()
         t = eng.reset_timing()
         max_chunk_step_tokens = max(max_chunk_step_tokens, t["chunk_tokens"])
+        for k, v in t.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                # Counters sum across the per-step drains; snapshot/ratio
+                # keys (decode_window, hit/acceptance rates, tokens-per-
+                # verify) keep the last nonzero value — summing a rate
+                # across hundreds of drains would report nonsense.
+                if k == "decode_window" or k.endswith("_rate") \
+                        or k.endswith("per_verify"):
+                    totals[k] = v if v else totals.get(k, 0)
+                else:
+                    totals[k] = totals.get(k, 0) + v
         for rid in rids:
             n = len(reqs[rid].generated)
             if n > seen[rid]:
@@ -101,6 +115,7 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
             and len(long_req.generated) > 0
         ):
             t_long_first = now
+    wall_s = time.perf_counter() - t_run0
     s = itl.summary()
     return {
         "itl_p50_ms": round(s["p50"] * 1e3, 3),
@@ -112,6 +127,11 @@ def _run_scenario(eng, shorts, long_prompt, short_new, long_new, warm_tokens):
         "max_live_prefill_dispatch_tokens": max(live_widths, default=0),
         "max_chunk_tokens_per_step": max_chunk_step_tokens,
         "steps": steps,
+        "wall_s": round(wall_s, 4),
+        "steps_per_s": round(steps / wall_s, 2) if wall_s > 0 else None,
+        # Standard bench metrics block (ISSUE 9): registry gauges + the
+        # summed reset_timing counters of the measured run.
+        "metrics": bench_metrics_block(eng, timing=totals),
     }
 
 
@@ -193,7 +213,45 @@ def _overload_summary(recs, step_times, mode):
     ts, is_ = ttft.summary(), itl.summary()
     offered = len(recs)
     n_shed = outcomes.get("shed", 0)
+    # Per-priority-class TTFT/ITL percentiles (ISSUE 9 satellite; seeds
+    # the ROADMAP multi-tenant SLO item): one registry section per class,
+    # snapshotted into the JSON line — the named-snapshot API the engine's
+    # future per-class accounting will feed directly.
+    from orion_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    for prio in sorted({r["priority"] for r in recs}):
+        # Section names are identifier-shaped; negative classes spell the
+        # sign out ("classneg1") instead of crashing register().
+        section = f"class{prio}" if prio >= 0 else f"classneg{-prio}"
+        cttft, citl = LatencyStats(), LatencyStats()
+        n_done = n_offered = 0
+        for r in recs:
+            if r["priority"] != prio:
+                continue
+            n_offered += 1
+            if r["req"].outcome != "completed":
+                continue
+            n_done += 1
+            if r["first"] is not None:
+                cttft.record(r["first"] - r["submit"])
+            for g in r["gaps"]:
+                citl.record(g)
+
+        def provider(t=cttft, i=citl, done=n_done, off=n_offered):
+            tsum, isum = t.summary(), i.summary()
+            return {
+                "offered": off,
+                "completed": done,
+                "ttft_p50_ms": round(tsum["p50"] * 1e3, 3),
+                "ttft_p99_ms": round(tsum["p99"] * 1e3, 3),
+                "itl_p50_ms": round(isum["p50"] * 1e3, 3),
+                "itl_p99_ms": round(isum["p99"] * 1e3, 3),
+            }
+
+        reg.register(section, provider)
     return {
+        "per_class": reg.snapshot(),
         "mode": mode,
         "offered": offered,
         "outcomes": outcomes,
@@ -278,6 +336,9 @@ def overload_main(smoke: bool) -> int:
         t = eng.reset_timing()
         r["engine_shed"] = t["shed_requests"]
         r["engine_expired"] = t["expired_requests"]
+        from orion_tpu.obs import bench_metrics_block
+
+        r["metrics"] = bench_metrics_block(eng, timing=t)
         results[mode] = r
         print(json.dumps(r))
     un, ov = results["uncontended"], results["overload"]
@@ -312,6 +373,10 @@ def overload_main(smoke: bool) -> int:
 
 def main() -> int:
     smoke = "--smoke" in sys.argv[1:] or "--cpu" in sys.argv[1:]
+    # --trace: run the same scenario with the span tracer ON — the
+    # steps_per_s / wall_s delta vs a plain run IS the tracer-overhead
+    # measurement (PERF.md "Tracer overhead").
+    trace = "--trace" in sys.argv[1:]
     if smoke:
         jax.config.update("jax_platforms", "cpu")
     elif jax.default_backend() != "tpu":
@@ -343,6 +408,8 @@ def main() -> int:
         budget, long_len, short_len = 256, 1536, 32
         n_short, short_new, long_new, warm = 4, 128, 8, 8
 
+    if trace:
+        base = base + ["inference.trace=true"]
     rng = np.random.default_rng(0)
     cfg_cold = get_config(preset, base)
     cfg_chunk = get_config(preset, base + [
@@ -363,6 +430,7 @@ def main() -> int:
         r = _run_scenario(eng, shorts, long_prompt, short_new, long_new,
                           warm)
         r["mode"] = mode
+        r["trace"] = trace
         r["prefill_chunk_tokens"] = budget if mode == "chunked" else None
         results[mode] = r
         print(json.dumps(r))

@@ -79,6 +79,11 @@ def _run(eng, prompts, max_new):
         if key in t:
             out[key] = round(t[key], 4) if isinstance(t[key], float) \
                 else t[key]
+    from orion_tpu.obs import bench_metrics_block
+
+    # Standard bench metrics block (ISSUE 9): registry gauges + the
+    # drained reset_timing window of the measured run.
+    out["metrics"] = bench_metrics_block(eng, timing=t)
     return out, {rid: list(reqs[rid].generated) for rid in rids}
 
 

@@ -30,6 +30,14 @@ def main(argv: list[str] | None = None) -> int:
              "from train.max_restarts",
     )
     parser.add_argument(
+        "--trace", metavar="PATH", default=None,
+        help="export a Chrome trace-event JSON of per-step host phases "
+             "(data/dispatch/guard/ckpt) to PATH when fit ends; sugar for "
+             "train.trace=true + train.trace_path=PATH (combine with "
+             "train.profile_steps for a device profile over the same "
+             "window)",
+    )
+    parser.add_argument(
         "overrides", nargs="*", help="dotted config overrides, e.g. model.n_layers=4"
     )
     args = parser.parse_args(argv)
@@ -40,7 +48,10 @@ def main(argv: list[str] | None = None) -> int:
         print("\n".join(list_presets()))
         return 0
 
-    cfg = get_config(args.preset, args.overrides)
+    overrides = list(args.overrides)
+    if args.trace is not None:
+        overrides += ["train.trace=true", f"train.trace_path={args.trace}"]
+    cfg = get_config(args.preset, overrides)
     if args.print_config:
         print(cfg.to_json())
         return 0
