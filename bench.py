@@ -58,6 +58,18 @@ TRAIN_PROBES: dict[str, tuple[list, int]] = {
     "scan_group2_gradbf16": (
         ["model.scan_group=2", "train.grad_dtype=bfloat16"], 720),
     "gradbf16": (["train.grad_dtype=bfloat16"], 600),
+    # ZeRO-1 probes (ISSUE 10): dp=4 optimizer-state sharding — these need
+    # a >=4-chip window (a v5e-4 / v5p slice); on the 1-chip dev box the
+    # Trainer's device-count validation makes them a fast recorded `error`
+    # line rather than a burned window, and tunnel_window's bench_probes
+    # entry (--probe all) queues them automatically for the next window.
+    "zero1": (["parallel.dp=4", "train.zero1=true"], 720),
+    "zero1_int8": (
+        ["parallel.dp=4", "train.zero1=true",
+         "train.zero1_quantize=int8"], 720),
+    "zero1_scan_group4_names": (
+        ["parallel.dp=4", "train.zero1=true", "model.scan_group=4",
+         "train.remat=names"], 780),
 }
 PROBE_STEADY_S = 240   # post-compile step allowance per probe
 PROBE_STEPS = 12       # compile + a few steady-state steps
@@ -256,7 +268,9 @@ def run_train_probe(
     is recorded as `compile_timeout` — the round-3 failure mode ("compile
     >12 min, never measured") becomes data instead of a burned window.
     """
+    env = None
     if cpu:
+        import os
         import pathlib
 
         train_py = str(pathlib.Path(__file__).resolve().parent / "train.py")
@@ -265,6 +279,14 @@ def run_train_probe(
                 "data.batch_size=4", "data.seq_len=64",
                 f"train.num_steps={steps}", "train.log_interval=1000",
                 "optimizer.warmup_steps=2"] + overrides + extra
+        # Fake multi-device CPU backend so dp-axis probes (the zero1
+        # grid needs dp=4) logic-check on one host, like the test suite.
+        env = dict(os.environ)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     else:
         args = [sys.executable, __file__, "--train-only",
                 "--skip-device-probe", f"train.num_steps={steps}",
@@ -274,7 +296,7 @@ def run_train_probe(
     try:
         r = subprocess.run(
             args, capture_output=True, text=True,
-            timeout=budget_s + PROBE_STEADY_S,
+            timeout=budget_s + PROBE_STEADY_S, env=env,
         )
     except subprocess.TimeoutExpired as e:
         stdout = e.stdout

@@ -22,8 +22,11 @@ for the preemptible-TPU fault matrix:
     reassembles exactly the slices each local device needs
     (``jax.make_array_from_callback``), so a checkpoint written on one
     mesh restores onto another — the manifest carries per-array sharding
-    from day one (the reshard/ZeRO-1 groundwork, PAPERS.md 2112.01075 /
-    2004.13336). Which step is "newest intact" is a FLEET decision:
+    (PAPERS.md 2112.01075 / 2004.13336), which is what lets ZeRO-1's
+    dp-sharded optimizer state (``train.zero1``) save under one dp degree
+    and restore bitwise onto another, including onto a zero1-off layout
+    (the masterless state tree matches the baseline's leaf set; pinned in
+    tests/test_zero1.py). Which step is "newest intact" is a FLEET decision:
     ``runtime.distributed.agree_on_steps``/``agree_all`` make every host
     fall back together when any host's portion is damaged.
   - **Async saves** run the file I/O on a daemon worker thread over host

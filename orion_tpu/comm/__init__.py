@@ -20,10 +20,16 @@ from orion_tpu.comm.collectives import (
     reduce_scatter,
     ring_shift,
 )
-from orion_tpu.comm.quantized import quantized_all_reduce
+from orion_tpu.comm.quantized import (
+    quantized_all_gather,
+    quantized_all_reduce,
+    quantized_reduce_scatter,
+)
 
 __all__ = [
+    "quantized_all_gather",
     "quantized_all_reduce",
+    "quantized_reduce_scatter",
     "all_gather",
     "all_reduce",
     "all_to_all",

@@ -1,4 +1,4 @@
-"""Block-quantized all-reduce (EQuARX-class; PAPERS.md:5).
+"""Block-quantized collectives (EQuARX-class; PAPERS.md:5).
 
 The reference's NCCL all-reduce moves gradients at full precision; EQuARX
 shows the wire traffic can ride int8 with per-block scales at negligible
@@ -7,23 +7,28 @@ between slices, exactly where the hybrid mesh places the ``dp`` axis;
 ``runtime/mesh.py`` ``dcn_axes``).
 
 XLA owns the collective schedule, so unlike NCCL we cannot quantize each
-ring hop. Instead this is the two-phase quantized exchange: both phases
-move int8 payloads (plus float32 per-block scales, ``1/block`` overhead),
-and the reduction itself happens in float32 on-device:
+ring hop. Instead each collective is a quantized *exchange*: the wire
+payload is int8 (plus float32 per-block scales, ``1/block`` overhead) and
+all arithmetic happens in float32 on-device. Three members:
 
-    phase 1  all_to_all   int8 shards + scales  -> each device holds every
-             peer's copy of its 1/n slice; dequantize, sum in f32
-             (a reduce-scatter with quantized wire format)
-    phase 2  all_gather   int8 reduced slice + scales -> dequantize
-             (an all-gather with quantized wire format)
+    quantized_reduce_scatter   all_to_all of int8 shards; each device
+                               dequantizes every peer's copy of its 1/n
+                               slice and sums in f32
+    quantized_all_gather       all_gather of an int8 local slice + scales;
+                               dequantize
+    quantized_all_reduce       the composition of the two (flat layout)
 
-Wire bytes ~ (2/n + 2) * size vs ``psum``'s 2 * (n-1)/n * 2 * size for
-bf16 — a ~2x reduction vs bf16, ~4x vs f32, at an error bounded by one
-quantization step per phase (amax/127 per block, two phases).
+Wire bytes for the all-reduce ~ (2/n + 2) * size vs ``psum``'s
+2 * (n-1)/n * 2 * size for bf16 — a ~2x reduction vs bf16, ~4x vs f32, at
+an error bounded by one quantization step per phase (amax/127 per block).
+The reduce-scatter / all-gather pair carries the ZeRO-1 weight-update
+sharding legs (``train.zero1_quantize``; PAPERS.md 2004.13336): partial
+gradients scatter int8, updated params gather int8.
 
 Usable only inside ``shard_map`` manual over ``axis``, like every wrapper
-in ``comm.collectives``. The trainer exposes it for pure-DP gradient
-reduction via ``train.grad_quant_bits=8`` (see ``train/trainer.py``).
+in ``comm.collectives``. The trainer exposes the all-reduce for pure-DP
+gradient reduction via ``train.grad_quant_bits=8`` and the scatter/gather
+pair via ``train.zero1_quantize`` (see ``train/trainer.py``).
 """
 
 from __future__ import annotations
@@ -53,6 +58,45 @@ def _dequantize(q: jax.Array, scale: jax.Array, block: int) -> jax.Array:
     ).reshape(-1)
 
 
+def _rs_flat(
+    flat: jax.Array, axis: Axis, n: int, slice_elems: int, block: int
+) -> jax.Array:
+    """Reduce-scatter with int8 wire format on a flat f32 [n*slice_elems]
+    array whose slices are whole numbers of blocks: quantize locally,
+    all_to_all the slices, dequantize and sum this device's slice in f32.
+    Returns the local reduced slice, f32 [slice_elems]."""
+    q, s = _quantize(flat, block)
+    q = q.reshape(n, slice_elems)
+    s = s.reshape(n, slice_elems // block)
+    # all_to_all with a leading device dim: device d receives stacked
+    # [n, slice] = every peer's copy of slice d.
+    q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    s_recv = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+    q_recv = q_recv.reshape(n, slice_elems)
+    s_recv = s_recv.reshape(n, slice_elems // block)
+    return jax.vmap(_dequantize, in_axes=(0, 0, None))(
+        q_recv, s_recv, block
+    ).sum(axis=0)
+
+
+def _ag_flat(
+    local: jax.Array, axis: Axis, block: int
+) -> jax.Array:
+    """All-gather with int8 wire format on a flat f32 local slice whose
+    length is a whole number of blocks. Returns f32 [n*slice_elems]."""
+    q, s = _quantize(local, block)
+    q_all = lax.all_gather(q, axis, axis=0, tiled=True)
+    s_all = lax.all_gather(s, axis, axis=0, tiled=True)
+    return _dequantize(q_all, s_all, block)
+
+
+def _pad_blocks(flat: jax.Array, elems: int, block: int) -> jax.Array:
+    pad = -(-elems // block) * block - elems
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat
+
+
 def quantized_all_reduce(
     x: jax.Array,
     axis: Axis,
@@ -76,33 +120,98 @@ def quantized_all_reduce(
         return red / n if mean else red
 
     flat = x.astype(jnp.float32).reshape(-1)
-    # Pad so every device's slice is a whole number of blocks.
+    # Pad so every device's slice is a whole number of blocks (pad unit
+    # n*block <=> slice unit block).
     slice_elems = -(-size // (n * block)) * block
-    pad = n * slice_elems - size
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    flat = _pad_blocks(flat, size, n * block)
 
-    # Phase 1: quantize locally, exchange slices, reduce own slice in f32.
-    q, s = _quantize(flat, block)
-    q = q.reshape(n, slice_elems)
-    s = s.reshape(n, slice_elems // block)
-    # all_to_all with a leading device dim: device d receives stacked
-    # [n, slice] = every peer's copy of slice d.
-    q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
-    s_recv = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
-    q_recv = q_recv.reshape(n, slice_elems)
-    s_recv = s_recv.reshape(n, slice_elems // block)
-    reduced = jax.vmap(_dequantize, in_axes=(0, 0, None))(
-        q_recv, s_recv, block
-    ).sum(axis=0)
+    reduced = _rs_flat(flat, axis, n, slice_elems, block)
     if mean:
         reduced = reduced / n
-
-    # Phase 2: quantize the reduced slice, gather all slices.
-    q2, s2 = _quantize(reduced, block)
-    q_all = lax.all_gather(q2, axis, axis=0, tiled=True)
-    s_all = lax.all_gather(s2, axis, axis=0, tiled=True)
-    out = _dequantize(q_all, s_all, block)
-    if pad:
+    out = _ag_flat(reduced, axis, block)
+    if out.size != size:
         out = out[:size]
     return out.reshape(x.shape).astype(x.dtype)
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    axis: Axis,
+    *,
+    scatter_dim: int = 0,
+    block: int = 256,
+    mean: bool = False,
+) -> jax.Array:
+    """Sum (or mean) ``x`` across ``axis``, leaving each device with its
+    own 1/n chunk along ``scatter_dim``, with int8 wire traffic.
+
+    ``x.shape[scatter_dim]`` must divide by the axis size. The ZeRO-1
+    gradient leg: every device holds a partial-sum copy of the full
+    gradient; the exchange moves int8 shards + f32 per-block scales and
+    each device sums its own chunk exactly in f32 (error bounded by one
+    quantization step per element of each PARTIAL term). Chunks smaller
+    than one block fall back to a full-precision psum + local slice.
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x / n if mean else x
+    d = x.shape[scatter_dim]
+    if d % n:
+        raise ValueError(
+            f"scatter_dim {scatter_dim} of shape {x.shape} must divide by "
+            f"axis size {n}"
+        )
+    c = d // n
+    rest = tuple(
+        s for i, s in enumerate(x.shape) if i != scatter_dim
+    )
+    xm = jnp.moveaxis(x.astype(jnp.float32), scatter_dim, 0).reshape(n, -1)
+    chunk = xm.shape[1]  # c * prod(rest)
+    if chunk < block:
+        # Sum in f32 like the main path — a bf16 leaf must not get a
+        # LESS accurate reduction just because it is small.
+        red = lax.psum(x.astype(jnp.float32), axis)
+        if mean:
+            red = red / n
+        local = lax.dynamic_slice_in_dim(
+            red, lax.axis_index(axis) * c, c, axis=scatter_dim
+        )
+        return local.astype(x.dtype)
+    # Per-row padding keeps each device's slice a whole number of blocks.
+    slice_elems = -(-chunk // block) * block
+    if slice_elems != chunk:
+        xm = jnp.concatenate(
+            [xm, jnp.zeros((n, slice_elems - chunk), jnp.float32)], axis=1
+        )
+    reduced = _rs_flat(xm.reshape(-1), axis, n, slice_elems, block)[:chunk]
+    if mean:
+        reduced = reduced / n
+    out = reduced.reshape((c,) + rest)
+    return jnp.moveaxis(out, 0, scatter_dim).astype(x.dtype)
+
+
+def quantized_all_gather(
+    x: jax.Array,
+    axis: Axis,
+    *,
+    gather_dim: int = 0,
+    block: int = 256,
+) -> jax.Array:
+    """Concatenate per-device chunks along ``gather_dim`` with int8 wire
+    traffic (the ZeRO-1 updated-param leg). Chunks smaller than one block
+    fall back to a plain all_gather."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    c = x.shape[gather_dim]
+    rest = tuple(s for i, s in enumerate(x.shape) if i != gather_dim)
+    flat = jnp.moveaxis(x.astype(jnp.float32), gather_dim, 0).reshape(-1)
+    chunk = flat.shape[0]
+    if chunk < block:
+        return lax.all_gather(x, axis, axis=gather_dim, tiled=True)
+    slice_elems = -(-chunk // block) * block
+    flat = _pad_blocks(flat, chunk, block)
+    out = _ag_flat(flat, axis, block)
+    out = out.reshape(n, slice_elems)[:, :chunk]
+    out = out.reshape((n * c,) + rest)
+    return jnp.moveaxis(out, 0, gather_dim).astype(x.dtype)

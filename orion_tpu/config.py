@@ -481,6 +481,29 @@ class TrainConfig:
     # with pure DP (fsdp=tp=pp=sp=ep=1) — the bandwidth win targets the
     # DCN-crossing dp axis of hybrid meshes. None => full-precision psum.
     grad_quant_bits: Optional[int] = None
+    # --- ZeRO-1 optimizer-state sharding (PAPERS.md 2004.13336) ----------
+    # Shard the weight update and optimizer state 1/dp across the dp axis:
+    # gradients reduce-scatter over dp, each replica updates only its own
+    # 1/dp shard of the Adam moments (and, when model.param_dtype differs
+    # from model.dtype, of a separate f32 master copy carried in the
+    # optimizer state), and the updated (cast-down) params all-gather back.
+    # Expressed TPU-natively as sharding constraints inside the jit train
+    # step (XLA emits the reduce-scatter/all-gather pair); the losses and
+    # the post-step full (all-gathered) state are bitwise-equal to the
+    # unsharded dp baseline. Needs parallel.dp > 1; composes with
+    # grad_accum / scan_group / remat / fsdp / tp; rejected under pp until
+    # stage-local dp is plumbed. See PERF.md "ZeRO-1".
+    zero1: bool = False
+    # Wire precision of the two ZeRO-1 collective legs on the (DCN-riding)
+    # dp axis. None = full-precision legs via sharding constraints (the
+    # bitwise path). "int8" = both legs blockwise-int8 through the explicit
+    # shard_map path (comm.quantized_reduce_scatter / quantized_all_gather,
+    # ~4x less DCN traffic than f32, error bounded by one quantization
+    # step per leg); "rs_int8" / "ag_int8" quantize only the grad
+    # reduce-scatter / param all-gather leg. The int8 path needs a pure-DP
+    # mesh (the wire legs run manual over dp) and computes the clip norm
+    # from the local shards (allclose, not bitwise, to the baseline).
+    zero1_quantize: Optional[str] = None
     # --- Fault tolerance (README "Training robustness") -------------------
     # Gradient anomaly guard: fold a donation-safe all-finite (loss + every
     # grad leaf) and global-norm-spike check into the compiled train step.
@@ -550,6 +573,11 @@ class TrainConfig:
         if self.max_restarts is None or self.max_restarts < 0:
             raise ValueError(
                 f"train.max_restarts={self.max_restarts} must be >= 0"
+            )
+        if self.zero1_quantize not in (None, "int8", "rs_int8", "ag_int8"):
+            raise ValueError(
+                f"train.zero1_quantize={self.zero1_quantize!r}; pick "
+                f"none|int8|rs_int8|ag_int8"
             )
         if self.trace_ring is None or self.trace_ring < 1:
             raise ValueError(

@@ -16,6 +16,8 @@ from orion_tpu.parallel.sharding import (
     logical_to_spec,
     param_shardings,
     shard_init,
+    zero1_shardings,
+    zero1_update_dim,
 )
 from orion_tpu.parallel.pipeline import pipeline_forward
 from orion_tpu.parallel.reshard import reshard
@@ -31,6 +33,8 @@ __all__ = [
     "logical_to_spec",
     "param_shardings",
     "shard_init",
+    "zero1_shardings",
+    "zero1_update_dim",
     "pipeline_forward",
     "reshard",
     "ring_attention",

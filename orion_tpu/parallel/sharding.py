@@ -95,6 +95,71 @@ def param_shardings(
     )
 
 
+def zero1_update_dim(
+    shape: Sequence[int], spec: P, n: int
+) -> Optional[int]:
+    """Pick the dimension a leaf's weight update shards over for ZeRO-1
+    (PAPERS.md 2004.13336): the largest dim divisible by the axis size
+    ``n`` among dims no other mesh axis already shards (ties break to the
+    lowest index, so the choice is deterministic across processes). None
+    when no dim qualifies — that leaf's update stays replicated (norm
+    scales / biases; the wire and memory saving there is nil anyway)."""
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    best: Optional[int] = None
+    for d, size in enumerate(shape):
+        if entries[d] is not None or size == 0 or size % n:
+            continue
+        if best is None or size > shape[best]:
+            best = d
+    return best
+
+
+def zero1_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    shapes: Any,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+    *,
+    axis: str = "dp",
+) -> tuple[Any, Any]:
+    """(shardings, dims) pytrees for ZeRO-1 dp-sharded optimizer state.
+
+    Each leaf's base spec comes from its logical axes (exactly
+    ``param_shardings``); ``axis`` is then inserted at the dim
+    ``zero1_update_dim`` picks, so master params and Adam moments live
+    1/|axis| per replica while composing with fsdp/tp sharding on the
+    other dims. ``dims`` records the chosen dim per leaf (-1 =
+    replicated; an int sentinel, not None, because None leaves vanish
+    from a pytree) — the explicit shard_map wire path needs it to place
+    the reduce-scatter/all-gather on the right dimension.
+    """
+    n = mesh.shape.get(axis, 1)
+
+    def leaf(axes, shape_leaf):
+        shape = tuple(shape_leaf.shape)
+        base = logical_to_spec(axes, rules, mesh)
+        if n <= 1:
+            return NamedSharding(mesh, base), -1
+        d = zero1_update_dim(shape, base, n)
+        if d is None:
+            return NamedSharding(mesh, base), -1
+        entries = list(tuple(base)) + [None] * (len(shape) - len(tuple(base)))
+        entries[d] = axis
+        return NamedSharding(mesh, P(*entries)), d
+
+    pairs = jax.tree.map(
+        leaf, logical_tree, shapes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    is_pair = lambda x: (
+        isinstance(x, tuple) and len(x) == 2
+        and isinstance(x[0], NamedSharding)
+    )
+    shardings = jax.tree.map(lambda t: t[0], pairs, is_leaf=is_pair)
+    dims = jax.tree.map(lambda t: t[1], pairs, is_leaf=is_pair)
+    return shardings, dims
+
+
 def batch_sharding(
     mesh: Mesh,
     rules: Mapping[str, MeshAxes] = DEFAULT_RULES,

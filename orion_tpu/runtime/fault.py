@@ -2,11 +2,11 @@
 training and serving stacks (SURVEY.md §6 "Failure detection / elastic
 recovery / fault injection").
 
-Promoted from ``orion_tpu.train.fault`` (which re-exports for
-compatibility): the serving engine needs exactly the same machinery the
-trainer grew — preemption flagging for SIGTERM drains, a stall watchdog
-around the step loop, and an inject-and-assert-recovery test pattern — so
-the module lives with the runtime now.
+Promoted from ``orion_tpu.train.fault`` (whose deprecation shim is now
+removed): the serving engine needs exactly the same machinery the trainer
+grew — preemption flagging for SIGTERM drains, a stall watchdog around
+the step loop, and an inject-and-assert-recovery test pattern — so the
+module lives with the runtime.
 
 TPU-native mapping of the reference's torchelastic-class machinery:
 

@@ -7,17 +7,27 @@ rules in orion_tpu.parallel.
 """
 
 from orion_tpu.train.optimizer import (
+    Zero1Plan,
     init_opt_state,
     make_schedule,
     apply_updates,
 )
-from orion_tpu.train.trainer import Trainer, make_train_step, init_train_state
+from orion_tpu.train.trainer import (
+    Trainer,
+    init_train_state,
+    make_train_step,
+    make_zero1_plan,
+    zero1_master_split,
+)
 
 __all__ = [
     "Trainer",
+    "Zero1Plan",
     "apply_updates",
     "init_opt_state",
     "init_train_state",
     "make_schedule",
     "make_train_step",
+    "make_zero1_plan",
+    "zero1_master_split",
 ]

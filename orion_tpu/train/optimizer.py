@@ -5,19 +5,72 @@ params>, "count": scalar}`` rather than an opaque optax chain state, so the
 ZeRO-3 story is one line: moments inherit the parameters' NamedShardings
 (SURVEY.md §3 FSDP row — params+grads+opt state all sharded). Schedules come
 from optax (pure functions, no state).
+
+ZeRO-1 (``train.zero1``; PAPERS.md 2004.13336) rides the same tree: a
+:class:`Zero1Plan` tells :func:`apply_updates` to run the weight update on
+each replica's 1/dp shard of the state. Two formulations share the math:
+
+  - the **auto** path (``plan.quantize is None``) expresses the sharding as
+    ``with_sharding_constraint`` inside the jit train step — XLA's SPMD
+    partitioner emits the gradient reduce-scatter and the updated-param
+    all-gather itself, and the result is bitwise-equal to the unsharded
+    baseline (the clip norm is pinned to the baseline's replicated layout);
+  - the **manual** path (any int8 leg) must be called inside ``shard_map``
+    over ``plan.axis`` with per-replica PARTIAL gradients: the two wire
+    legs run explicitly through ``comm.quantized_reduce_scatter`` /
+    ``quantized_all_gather`` so the DCN exchange is blockwise int8.
+
+With ``model.param_dtype != model.dtype`` the optimizer state additionally
+carries a dp-sharded f32 ``master`` copy (``init_opt_state(master=True)``)
+and ``state["params"]`` holds only the cast-down working copy the forward
+reads — the all-gather leg then moves the narrow dtype.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from orion_tpu.config import OptimizerConfig
 
 OptState = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero1Plan:
+    """How the weight update shards across the data-parallel axis.
+
+    ``dims`` is a pytree (mirroring params) of the per-leaf update-shard
+    dim (-1 = replicated), ``state_shardings``/``param_shardings`` the
+    dp-sharded master/moment layouts and the baseline layouts params
+    return to (``parallel.sharding.zero1_shardings``). ``quantize`` picks
+    the wire format per collective leg: None (both fp32, the bitwise
+    constraint path), "int8" (both legs), "rs_int8"/"ag_int8" (one leg).
+    """
+
+    axis: str
+    dims: Any
+    state_shardings: Any
+    param_shardings: Any
+    quantize: Optional[str] = None
+    block: int = 256
+
+    @property
+    def manual(self) -> bool:
+        return self.quantize is not None
+
+    @property
+    def rs_int8(self) -> bool:
+        return self.quantize in ("int8", "rs_int8")
+
+    @property
+    def ag_int8(self) -> bool:
+        return self.quantize in ("int8", "ag_int8")
 
 # Parameter leaves exempt from weight decay: norm scales and all biases.
 _NO_DECAY_KEYS = frozenset(
@@ -52,17 +105,27 @@ def make_schedule(
     )
 
 
-def init_opt_state(params: Any, cfg: OptimizerConfig) -> OptState:
+def init_opt_state(
+    params: Any, cfg: OptimizerConfig, *, master: bool = False
+) -> OptState:
+    """Fresh optimizer state for ``params``. With ``master`` (the ZeRO-1
+    mixed-precision split, ``train.zero1`` when param_dtype != dtype) the
+    state additionally carries the full-precision master copy — ``params``
+    must still be in param_dtype here; the trainer casts the working copy
+    down afterwards."""
     mdt = jnp.dtype(cfg.moment_dtype)
 
     def zeros(p):
         return jnp.zeros(p.shape, mdt)
 
-    return {
+    state = {
         "mu": jax.tree.map(zeros, params),
         "nu": jax.tree.map(zeros, params),
         "count": jnp.zeros((), jnp.int32),
     }
+    if master:
+        state["master"] = jax.tree.map(lambda p: p, params)
+    return state
 
 
 def _decay_mask(path) -> bool:
@@ -93,29 +156,16 @@ def tree_all_finite(tree: Any) -> jax.Array:
     return ok
 
 
-def apply_updates(
-    params: Any,
-    grads: Any,
-    opt_state: OptState,
+def _make_leaf_update(
     cfg: OptimizerConfig,
     learning_rate: jax.Array,
-    gnorm: Optional[jax.Array] = None,
-) -> tuple[Any, OptState, dict[str, jax.Array]]:
-    """One optimizer update. Returns (params, opt_state, metrics).
-
-    ``gnorm`` lets a caller that already computed the global grad norm
-    (the anomaly guard) share it instead of paying the reduction twice.
-    """
-    if cfg.name not in ("adamw", "sgd"):
-        raise ValueError(f"unknown optimizer {cfg.name!r}")
-    if gnorm is None:
-        gnorm = global_norm(grads)
-    if cfg.grad_clip_norm > 0:
-        scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-9))
-    else:
-        scale = jnp.ones((), jnp.float32)
-
-    count = opt_state["count"] + 1
+    scale: jax.Array,
+    count: jax.Array,
+):
+    """The per-leaf AdamW/SGD math, shared by every apply_updates branch
+    (replicated, ZeRO-1 auto-sharded, ZeRO-1 manual). ``p`` must be the
+    update SOURCE (the master leaf under a mixed-precision split); the
+    returned new value keeps p's dtype."""
     cf = count.astype(jnp.float32)
     bc1 = 1.0 - cfg.b1 ** cf
     bc2 = 1.0 - cfg.b2 ** cf
@@ -142,15 +192,198 @@ def apply_updates(
         new_p = p.astype(jnp.float32) - learning_rate * step
         return new_p.astype(p.dtype), mu_f.astype(mdt), nu_f.astype(mdt)
 
-    flat = jax.tree_util.tree_map_with_path(
-        lambda path, p, g, mu, nu: upd(path, p, g, mu, nu),
-        params, grads, opt_state["mu"], opt_state["nu"],
+    return upd
+
+
+def _clip_scale(cfg: OptimizerConfig, gnorm: jax.Array) -> jax.Array:
+    if cfg.grad_clip_norm > 0:
+        return jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-9))
+    return jnp.ones((), jnp.float32)
+
+
+_IS_TRIPLE = lambda x: (
+    isinstance(x, tuple) and len(x) == 3 and not isinstance(x[0], tuple)
+)
+
+
+def _unzip3(flat: Any) -> tuple[Any, Any, Any]:
+    """Unzip a tree of 3-tuples back into three trees."""
+    return (
+        jax.tree.map(lambda t: t[0], flat, is_leaf=_IS_TRIPLE),
+        jax.tree.map(lambda t: t[1], flat, is_leaf=_IS_TRIPLE),
+        jax.tree.map(lambda t: t[2], flat, is_leaf=_IS_TRIPLE),
     )
-    # Unzip the 3-tuples back into three trees.
-    is_triple = lambda x: isinstance(x, tuple) and len(x) == 3 and not isinstance(x[0], tuple)
-    new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=is_triple)
-    new_mu = jax.tree.map(lambda t: t[1], flat, is_leaf=is_triple)
-    new_nu = jax.tree.map(lambda t: t[2], flat, is_leaf=is_triple)
+
+
+def apply_updates(
+    params: Any,
+    grads: Any,
+    opt_state: OptState,
+    cfg: OptimizerConfig,
+    learning_rate: jax.Array,
+    gnorm: Optional[jax.Array] = None,
+    zero1: Optional[Zero1Plan] = None,
+) -> tuple[Any, OptState, dict[str, jax.Array]]:
+    """One optimizer update. Returns (params, opt_state, metrics).
+
+    ``gnorm`` lets a caller that already computed the global grad norm
+    (the anomaly guard) share it instead of paying the reduction twice.
+    With a :class:`Zero1Plan` the update runs on each replica's 1/dp
+    shard of the master state (see the module docstring); the manual
+    (quantized) branch must be called inside ``shard_map`` over
+    ``zero1.axis`` with per-replica PARTIAL gradients and ignores any
+    passed ``gnorm`` (the norm must come from the reduced shards).
+    """
+    if cfg.name not in ("adamw", "sgd"):
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if zero1 is not None and zero1.manual:
+        return _apply_updates_manual(
+            params, grads, opt_state, cfg, learning_rate, zero1
+        )
+
+    wsc = jax.lax.with_sharding_constraint
+    if zero1 is not None:
+        # Pin the clip norm to the baseline's replicated grad layout:
+        # a norm taken over the dp shards would regroup the reduction and
+        # break bitwise parity with the unsharded run.
+        grads = wsc(grads, zero1.param_shardings)
+    if gnorm is None:
+        gnorm = global_norm(grads)
+    scale = _clip_scale(cfg, gnorm)
+    count = opt_state["count"] + 1
+    upd = _make_leaf_update(cfg, learning_rate, scale, count)
+
+    master = opt_state.get("master")
+    src = master if master is not None else params
+    mu, nu = opt_state["mu"], opt_state["nu"]
+    if zero1 is not None:
+        # The reduce-scatter leg: grads, masters and moments constrained
+        # onto the 1/dp update layout — XLA slices the (replicated) grads
+        # per shard and every op below runs shard-local.
+        src = wsc(src, zero1.state_shardings)
+        grads = wsc(grads, zero1.state_shardings)
+        mu = wsc(mu, zero1.state_shardings)
+        nu = wsc(nu, zero1.state_shardings)
+
+    flat = jax.tree_util.tree_map_with_path(upd, src, grads, mu, nu)
+    new_src, new_mu, new_nu = _unzip3(flat)
 
     new_state = {"mu": new_mu, "nu": new_nu, "count": count}
+    if zero1 is not None:
+        new_src = wsc(new_src, zero1.state_shardings)
+        new_state["mu"] = wsc(new_mu, zero1.state_shardings)
+        new_state["nu"] = wsc(new_nu, zero1.state_shardings)
+    if master is not None:
+        new_state["master"] = new_src
+        # The all-gather leg, in the cast-down working dtype: the wire
+        # moves model.dtype bytes, not the f32 masters.
+        new_params = jax.tree.map(
+            lambda m, p: m.astype(p.dtype), new_src, params
+        )
+    else:
+        new_params = new_src
+    if zero1 is not None:
+        new_params = wsc(new_params, zero1.param_shardings)
+    return new_params, new_state, {"grad_norm": gnorm}
+
+
+def _apply_updates_manual(
+    params: Any,
+    grads: Any,
+    opt_state: OptState,
+    cfg: OptimizerConfig,
+    learning_rate: jax.Array,
+    plan: Zero1Plan,
+) -> tuple[Any, OptState, dict[str, jax.Array]]:
+    """ZeRO-1 update inside a ``shard_map`` manual region over
+    ``plan.axis`` (the quantized-wire path, ``train.zero1_quantize``).
+
+    ``grads`` are this replica's PARTIAL per-shard means; masters and
+    moments arrive as local 1/dp shards (full for dims == -1 leaves);
+    ``params`` is the full working copy. Per leaf: reduce-scatter the
+    gradient onto its update dim (int8 wire when ``rs_int8``), update the
+    local shard, all-gather the updated cast-down params (int8 when
+    ``ag_int8``). The clip norm comes from the reduced shards — one
+    scalar psum, the standard ZeRO formulation (not bitwise vs the
+    replicated baseline, whose reduction groups differently).
+    """
+    from orion_tpu.comm.quantized import (
+        quantized_all_gather,
+        quantized_reduce_scatter,
+    )
+
+    axis, block = plan.axis, plan.block
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+
+    def rs(g, d):
+        if d < 0:
+            return lax.pmean(g, axis)
+        if plan.rs_int8:
+            return quantized_reduce_scatter(
+                g, axis, scatter_dim=d, block=block, mean=True
+            )
+        return lax.psum_scatter(
+            g, axis, scatter_dimension=d, tiled=True
+        ) / n
+
+    g_red = jax.tree.map(rs, grads, plan.dims)
+
+    # Global grad norm from the reduced shards: sharded leaves contribute
+    # local partial squares (summed once across the axis); dims == -1
+    # leaves are fully replicated and counted once, NOT psum'd.
+    sq_shard = jnp.zeros((), jnp.float32)
+    sq_repl = jnp.zeros((), jnp.float32)
+    for g, d in zip(jax.tree.leaves(g_red), jax.tree.leaves(plan.dims)):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if d < 0:
+            sq_repl = sq_repl + s
+        else:
+            sq_shard = sq_shard + s
+    gnorm = jnp.sqrt(lax.psum(sq_shard, axis) + sq_repl)
+    scale = _clip_scale(cfg, gnorm)
+    count = opt_state["count"] + 1
+    upd = _make_leaf_update(cfg, learning_rate, scale, count)
+
+    master = opt_state.get("master")
+
+    def src_shard(p, d):
+        """This replica's slice of the (replicated) working params — the
+        update source when there is no separate master copy."""
+        if d < 0:
+            return p
+        c = p.shape[d] // n
+        return lax.dynamic_slice_in_dim(p, idx * c, c, axis=d)
+
+    # Update source: the master shards when split (they already arrive as
+    # local 1/dp shards through the shard_map in_specs), else a local
+    # slice of the replicated working params.
+    src = (
+        master if master is not None
+        else jax.tree.map(src_shard, params, plan.dims)
+    )
+
+    flat = jax.tree_util.tree_map_with_path(upd, src, g_red,
+                                            opt_state["mu"],
+                                            opt_state["nu"])
+    new_src, new_mu, new_nu = _unzip3(flat)
+
+    def ag(m, p, d):
+        """The all-gather leg: updated shard -> full working copy, cast
+        down to the working dtype (the narrow-wire trick; int8 narrower
+        still under ag_int8)."""
+        if d < 0:
+            return m.astype(p.dtype)
+        if plan.ag_int8:
+            return quantized_all_gather(
+                m.astype(jnp.float32), axis, gather_dim=d, block=block
+            ).astype(p.dtype)
+        return lax.all_gather(
+            m.astype(p.dtype), axis, axis=d, tiled=True
+        )
+
+    new_params = jax.tree.map(ag, new_src, params, plan.dims)
+    new_state = {"mu": new_mu, "nu": new_nu, "count": count}
+    if master is not None:
+        new_state["master"] = new_src
     return new_params, new_state, {"grad_norm": gnorm}

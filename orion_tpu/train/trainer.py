@@ -25,9 +25,14 @@ from orion_tpu.ckpt import CheckpointManager
 from orion_tpu.config import Config
 from orion_tpu.data import make_loader
 from orion_tpu.models import init_params, loss_fn, param_logical_axes
-from orion_tpu.parallel import batch_sharding, param_shardings
+from orion_tpu.parallel import (
+    batch_sharding,
+    param_shardings,
+    zero1_shardings,
+)
 from orion_tpu.runtime import build_mesh, initialize
 from orion_tpu.train.optimizer import (
+    Zero1Plan,
     apply_updates,
     global_norm,
     init_opt_state,
@@ -56,23 +61,90 @@ class RollbackFailed(RuntimeError):
 _FIRED_FAULTS: set = set()
 
 
+def zero1_master_split(cfg: Config) -> bool:
+    """Whether ZeRO-1 carries a separate dp-sharded master copy.
+
+    Two reasons to split:
+
+    - mixed precision (``param_dtype != dtype``): ``state['params']``
+      holds the cast-down working copy the forward reads and
+      ``opt['master']`` the sharded full-precision source of truth;
+    - a quantized all-gather leg (``zero1_quantize=int8|ag_int8``): the
+      gathered params are an int8 round-trip, and WITHOUT a master the
+      owner's own shard would re-enter the next update quantized — a
+      per-step error random walk that compounds over a long run. With
+      the master split the update always reads the exact master shards
+      and params are a bounded ONE-step quantization of them (and stay
+      bit-identical across replicas, since every device — owner
+      included — takes the same gathered bytes).
+
+    Otherwise the params ARE the masters and stay replicated — a separate
+    copy would cost memory, not save it."""
+    if not cfg.train.zero1:
+        return False
+    if jnp.dtype(cfg.model.param_dtype) != jnp.dtype(cfg.model.dtype):
+        return True
+    return cfg.train.zero1_quantize in ("int8", "ag_int8")
+
+
+def make_zero1_plan(cfg: Config, mesh) -> Optional[Zero1Plan]:
+    """The per-leaf ZeRO-1 update-sharding plan (train.zero1), or None."""
+    if not cfg.train.zero1:
+        return None
+    logical = param_logical_axes(cfg.model)
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg.model, jax.random.key(0))
+    )
+    zshard, dims = zero1_shardings(mesh, logical, shapes)
+    return Zero1Plan(
+        axis="dp",
+        dims=dims,
+        state_shardings=zshard,
+        param_shardings=param_shardings(mesh, logical),
+        quantize=cfg.train.zero1_quantize,
+    )
+
+
 def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
     params = init_params(cfg.model, key)
+    opt = init_opt_state(
+        params, cfg.optimizer, master=zero1_master_split(cfg)
+    )
+    if zero1_master_split(cfg):
+        wdt = jnp.dtype(cfg.model.dtype)
+        params = jax.tree.map(lambda p: p.astype(wdt), params)
     return {
         "params": params,
-        "opt": init_opt_state(params, cfg.optimizer),
+        "opt": opt,
         "step": jnp.zeros((), jnp.int32),
     }
 
 
-def state_shardings(cfg: Config, mesh) -> TrainState:
+def state_shardings(
+    cfg: Config, mesh, zero1_plan: Optional[Zero1Plan] = None
+) -> TrainState:
     """NamedShardings for the full train state: ZeRO-3 by construction —
-    moments share the params' shardings, scalars are replicated."""
-    pshard = param_shardings(mesh, param_logical_axes(cfg.model))
+    moments share the params' shardings, scalars are replicated. With
+    train.zero1 the moments (and the master copy, when split) instead take
+    the dp-sharded weight-update layout (parallel.sharding.zero1_shardings)
+    so each replica physically holds 1/dp of the optimizer state.
+    ``zero1_plan`` lets a caller that already built the plan (the Trainer)
+    reuse its layout trees instead of re-tracing the abstract init."""
+    if zero1_plan is None:
+        zero1_plan = make_zero1_plan(cfg, mesh)
+    if zero1_plan is not None:
+        pshard = zero1_plan.param_shardings
+        mshard = zero1_plan.state_shardings
+    else:
+        pshard = param_shardings(mesh, param_logical_axes(cfg.model))
+        mshard = pshard
     repl = NamedSharding(mesh, P())
+    opt = {"mu": mshard, "nu": mshard, "count": repl}
+    if zero1_master_split(cfg):
+        opt["master"] = mshard
     return {
         "params": pshard,
-        "opt": {"mu": pshard, "nu": pshard, "count": repl},
+        "opt": opt,
         "step": repl,
     }
 
@@ -98,11 +170,39 @@ def abstract_train_state(cfg: Config, shardings=None) -> TrainState:
     )
 
 
+def _require_unmasked_dp_batch(batch, knob: str) -> None:
+    """Shared guard for the manual-over-dp paths (grad_quant_bits and the
+    quantized zero1 wire legs): the combined ce+moe gradient cannot be
+    re-weighted by per-shard valid-token counts after the fact, so a
+    uniform pmean would bias shards with few valid tokens. Masked /
+    packed batches need the exact (XLA-inserted) reduction."""
+    if "loss_mask" in batch:
+        raise ValueError(
+            f"{knob} does not support loss_mask batches: dp shards with "
+            f"unequal valid-token counts need token-weighted reduction; "
+            f"use the full-precision automatic path"
+        )
+
+
+def _dp_mean_metrics(loss, aux):
+    """Reduce per-shard loss/aux across dp inside a manual region: means
+    everywhere except token counts, which accumulate."""
+    from jax import lax as _lax
+
+    loss = _lax.pmean(loss, "dp")
+    aux = {
+        k: _lax.psum(v, "dp") if k == "tokens" else _lax.pmean(v, "dp")
+        for k, v in aux.items()
+    }
+    return loss, aux
+
+
 def make_train_step(
     cfg: Config,
     schedule: Callable[[jax.Array], jax.Array],
     mesh: Any = None,
     poison: bool = False,
+    zero1: Optional[Zero1Plan] = None,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the compiled per-step function.
 
@@ -191,8 +291,6 @@ def make_train_step(
         # Pure DP is required: with the other axes at 1 the model forward
         # contains no cross-device collectives of its own, so the manual dp
         # region is self-contained.
-        from jax import lax as _lax
-
         from orion_tpu.comm.quantized import quantized_all_reduce
 
         if quant_bits != 8:
@@ -208,30 +306,14 @@ def make_train_step(
             )
 
         def reduced_loss_and_grads(params, batch):
-            if "loss_mask" in batch:
-                # The combined ce+moe gradient cannot be re-weighted by
-                # per-shard valid-token counts after the fact, so a uniform
-                # pmean would bias shards with few valid tokens. Masked /
-                # packed batches need the exact (full-precision, XLA-
-                # inserted) reduction.
-                raise ValueError(
-                    "train.grad_quant_bits does not support loss_mask "
-                    "batches: dp shards with unequal valid-token counts "
-                    "need token-weighted reduction; use full-precision"
-                )
+            _require_unmasked_dp_batch(batch, "train.grad_quant_bits")
 
             def body(params, batch):
                 loss, aux, grads = loss_and_grads(params, batch)
                 grads = jax.tree.map(
                     lambda g: quantized_all_reduce(g, "dp", mean=True), grads
                 )
-                loss = _lax.pmean(loss, "dp")
-                aux = {
-                    k: _lax.psum(v, "dp")
-                    if k == "tokens"
-                    else _lax.pmean(v, "dp")
-                    for k, v in aux.items()
-                }
+                loss, aux = _dp_mean_metrics(loss, aux)
                 return loss, aux, grads
 
             bspec = P(None, "dp") if accum > 1 else P("dp")
@@ -250,15 +332,72 @@ def make_train_step(
     else:
         grads_fn = loss_and_grads
 
+    manual_zero1 = zero1 is not None and zero1.manual
+    if manual_zero1:
+        # The quantized-wire ZeRO-1 path (train.zero1_quantize): the whole
+        # fwd/bwd + sharded update runs manual over dp, so the gradient
+        # exchange is the PARTIAL per-replica grads (the reduce-scatter
+        # leg quantizes real wire traffic, not an already-psum'd copy) and
+        # the updated params return through the explicit all-gather leg.
+        # Pure DP is required (Trainer validates): with the other axes at
+        # 1 the model forward contains no collectives of its own.
+        from jax import lax as _lax
+
+        zspec = jax.tree.map(lambda s: s.spec, zero1.state_shardings)
+        opt_spec: dict = {"mu": zspec, "nu": zspec, "count": P()}
+        if zero1_master_split(cfg):
+            opt_spec["master"] = zspec
+        bspec = P(None, "dp") if accum > 1 else P("dp")
+
+        def _manual_body(params, opt, batch, lr, want_finite):
+            loss, aux, grads = loss_and_grads(params, batch)
+            if want_finite:
+                # Checked on the LOCAL partial grads: the int8 wire leg
+                # would round a NaN away before a post-reduce check saw
+                # it. psum-of-bools == n <=> every replica finite.
+                fin = jnp.logical_and(
+                    jnp.isfinite(loss), tree_all_finite(grads)
+                )
+                fin = _lax.psum(
+                    fin.astype(jnp.int32), "dp"
+                ) >= _lax.axis_size("dp")
+            else:
+                fin = jnp.bool_(True)
+            new_params, new_opt, m = apply_updates(
+                params, grads, opt, cfg.optimizer, lr, zero1=zero1
+            )
+            loss, aux = _dp_mean_metrics(loss, aux)
+            return loss, aux, new_params, new_opt, m["grad_norm"], fin
+
+        def manual_update(state, batch, lr, want_finite):
+            _require_unmasked_dp_batch(batch, "train.zero1_quantize")
+            return jax.shard_map(
+                lambda p, o, b, lr_: _manual_body(
+                    p, o, b, lr_, want_finite
+                ),
+                mesh=mesh,
+                in_specs=(P(), opt_spec, bspec, P()),
+                out_specs=(P(), P(), P(), opt_spec, P(), P()),
+                check_vma=False,
+            )(state["params"], state["opt"], batch, lr)
+
     def train_step(state: TrainState, batch):
         params = state["params"]
-        with jax.named_scope("fwd_bwd"):
-            loss, aux, grads = grads_fn(params, batch)
         lr = schedule(state["opt"]["count"]).astype(jnp.float32)
-        with jax.named_scope("optimizer"):
-            new_params, new_opt, opt_metrics = apply_updates(
-                params, grads, state["opt"], cfg.optimizer, lr
-            )
+        if manual_zero1:
+            with jax.named_scope("fwd_bwd_zero1"):
+                loss, aux, new_params, new_opt, gnorm, _ = manual_update(
+                    state, batch, lr, False
+                )
+        else:
+            with jax.named_scope("fwd_bwd"):
+                loss, aux, grads = grads_fn(params, batch)
+            with jax.named_scope("optimizer"):
+                new_params, new_opt, opt_metrics = apply_updates(
+                    params, grads, state["opt"], cfg.optimizer, lr,
+                    zero1=zero1,
+                )
+            gnorm = opt_metrics["grad_norm"]
         new_state = {
             "params": new_params,
             "opt": new_opt,
@@ -268,7 +407,7 @@ def make_train_step(
             "loss": loss,
             "ce_loss": aux["ce_loss"],
             "moe_aux": aux["moe_aux"],
-            "grad_norm": opt_metrics["grad_norm"],
+            "grad_norm": gnorm,
             "lr": lr,
         }
         return new_state, step_metrics
@@ -289,8 +428,40 @@ def make_train_step(
         the params nor burns an LR-schedule position.
         """
         params = state["params"]
+        lr = schedule(state["opt"]["count"]).astype(jnp.float32)
+        if manual_zero1:
+            with jax.named_scope("fwd_bwd_zero1"):
+                (loss, aux, new_params, new_opt, gnorm,
+                 finite) = manual_update(state, batch, lr, True)
+            with jax.named_scope("anomaly_guard"):
+                spike = jnp.logical_and(finite, gnorm > norm_limit)
+                ok = jnp.logical_and(finite, jnp.logical_not(spike))
+            keep = lambda new, old: jnp.where(ok, new, old)
+            new_state = {
+                "params": jax.tree.map(keep, new_params, params),
+                "opt": jax.tree.map(keep, new_opt, state["opt"]),
+                "step": state["step"] + 1,
+            }
+            f32 = jnp.float32
+            return new_state, {
+                "loss": loss,
+                "ce_loss": aux["ce_loss"],
+                "moe_aux": aux["moe_aux"],
+                "grad_norm": gnorm,
+                "lr": lr,
+                "anomaly": jnp.logical_not(ok).astype(f32),
+                "nonfinite": jnp.logical_not(finite).astype(f32),
+                "spike": spike.astype(f32),
+            }
         with jax.named_scope("fwd_bwd"):
             loss, aux, grads = grads_fn(params, batch)
+        if zero1 is not None:
+            # Pin the guard's norm (and the clip below, via gnorm=) to the
+            # baseline's replicated grad layout — the bitwise-parity rule
+            # apply_updates applies when it computes the norm itself.
+            grads = jax.lax.with_sharding_constraint(
+                grads, zero1.param_shardings
+            )
         with jax.named_scope("anomaly_guard"):
             gnorm = global_norm(grads)
             finite = jnp.logical_and(
@@ -298,10 +469,10 @@ def make_train_step(
             )
             spike = jnp.logical_and(finite, gnorm > norm_limit)
             ok = jnp.logical_and(finite, jnp.logical_not(spike))
-        lr = schedule(state["opt"]["count"]).astype(jnp.float32)
         with jax.named_scope("optimizer"):
             new_params, new_opt, opt_metrics = apply_updates(
-                params, grads, state["opt"], cfg.optimizer, lr, gnorm=gnorm
+                params, grads, state["opt"], cfg.optimizer, lr,
+                gnorm=gnorm, zero1=zero1,
             )
         keep = lambda new, old: jnp.where(ok, new, old)
         new_state = {
@@ -347,6 +518,40 @@ class Trainer:
             raise ValueError(
                 "model.weight_quant is a serving-only knob (the engine "
                 "quantizes at init); training runs full-precision masters"
+            )
+        if cfg.train.zero1:
+            if cfg.parallel.dp < 2:
+                raise ValueError(
+                    "train.zero1 needs parallel.dp > 1: the optimizer "
+                    "state shards 1/dp across the dp axis"
+                )
+            if cfg.parallel.pp > 1:
+                raise ValueError(
+                    "train.zero1 is rejected under parallel.pp until "
+                    "stage-local dp is plumbed (the update sharding "
+                    "assumes a global dp axis; pipeline stages own "
+                    "disjoint layer shards)"
+                )
+            if cfg.train.grad_quant_bits:
+                raise ValueError(
+                    "train.zero1 replaces the dp gradient all-reduce with "
+                    "a reduce-scatter, so train.grad_quant_bits has no "
+                    "collective left to quantize; use train.zero1_quantize"
+                )
+            if cfg.train.zero1_quantize:
+                others = {
+                    k: v for k, v in cfg.parallel.axis_sizes.items()
+                    if k != "dp" and v > 1
+                }
+                if others:
+                    raise ValueError(
+                        f"train.zero1_quantize needs pure DP (the wire "
+                        f"legs run manual over dp); mesh has {others}"
+                    )
+        elif cfg.train.zero1_quantize:
+            raise ValueError(
+                "train.zero1_quantize without train.zero1 has no "
+                "ZeRO-1 collective legs to quantize"
             )
         if cfg.train.remat != "inherit" or cfg.train.remat_offload:
             # train.remat / train.remat_offload are the training-side
@@ -514,12 +719,19 @@ class Trainer:
             )
         initialize(cfg.runtime)
         self.mesh = build_mesh(cfg.parallel, platform=cfg.runtime.platform)
-        self.shardings = state_shardings(cfg, self.mesh)
+        # Plan first, shardings from it: both need the same abstract init
+        # trace; building the plan once avoids paying it twice.
+        self._zero1 = make_zero1_plan(self.cfg, self.mesh)
+        self.shardings = state_shardings(
+            cfg, self.mesh, zero1_plan=self._zero1
+        )
         self.batch_shard = self._batch_sharding()
         self.loader = make_loader(cfg.data, cfg.model.vocab_size)
         schedule = make_schedule(cfg.optimizer, cfg.train.num_steps)
         self._schedule = schedule
-        base_step = make_train_step(self.cfg, schedule, self.mesh)
+        base_step = make_train_step(
+            self.cfg, schedule, self.mesh, zero1=self._zero1
+        )
         if cfg.runtime.checkify:
             # Sanitizer mode (SURVEY.md §6, SANITIZERS.md): functionalized
             # device-side nan/inf + index-OOB checks; the error pytree is
@@ -540,6 +752,10 @@ class Trainer:
                 manual.append("moe_dispatch=sorted_a2a (explicit ep a2a)")
             if cfg.train.grad_quant_bits:
                 manual.append("train.grad_quant_bits (dp shard_map)")
+            if cfg.train.zero1_quantize:
+                manual.append(
+                    "train.zero1_quantize (dp shard_map wire legs)"
+                )
             if manual:
                 raise ValueError(
                     "runtime.checkify does not compose with manual "
@@ -695,11 +911,22 @@ class Trainer:
         (temp bytes = activations + workspace) and for whether the donated
         master-param/optimizer-state buffers were actually reused.
 
+        All state accounting is PER CHIP (``sharding.shard_shape``), so a
+        dp-sharded layout (train.zero1) shows its 1/dp master+moment
+        shrink directly; ``by_category`` breaks the per-chip bytes into
+        params / grads / master / moments / activations (grads and
+        activations are estimates: the effective grad dtype over the param
+        layout, and XLA's temp bytes — activations + workspace + transient
+        grads — respectively).
+
         With ``assert_donation`` (default), raise if any donated state
         bytes failed to alias into the outputs: an un-aliased master/
         moment buffer silently DOUBLES its footprint for the step, which
         is exactly the headroom that decides whether remat=names fits at
-        bench batch 8 (PERF.md). (Not called from the hot path: the AOT
+        bench batch 8 (PERF.md). The check compares per-chip donated bytes
+        against the per-executable alias size, so it covers sharded
+        layouts too; multi-PROCESS runs still skip it (this process only
+        sees its own executable). (Not called from the hot path: the AOT
         executable is separate from jit's own cache, so this costs one
         extra compile.)
         """
@@ -725,19 +952,49 @@ class Trainer:
         def _nbytes(leaf):
             return math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
 
+        def _chip_nbytes(leaf, dtype=None):
+            """Per-device bytes: the leaf's local shard (replicated dims
+            count in full on every chip). ``dtype`` overrides the leaf's
+            (the grads estimate prices the param layout at grad dtype)."""
+            sharding = getattr(leaf, "sharding", None)
+            shape = (
+                sharding.shard_shape(leaf.shape)
+                if sharding is not None else leaf.shape
+            )
+            dt = jnp.dtype(dtype if dtype is not None else leaf.dtype)
+            return math.prod(shape) * dt.itemsize
+
+        def _chip_tree(tree):
+            return sum(_chip_nbytes(x) for x in jax.tree.leaves(tree))
+
         donated = sum(_nbytes(leaf) for leaf in jax.tree.leaves(state))
+        donated_chip = _chip_tree(state)
+        opt = state["opt"]
+        gdt = jnp.dtype(
+            self.cfg.train.grad_dtype
+            if self.cfg.train.grad_dtype is not None
+            else jax.tree.leaves(state["params"])[0].dtype
+        )
+        by_category = {
+            "params": _chip_tree(state["params"]),
+            "grads": sum(
+                _chip_nbytes(p, gdt)
+                for p in jax.tree.leaves(state["params"])
+            ),
+            "master": _chip_tree(opt["master"]) if "master" in opt else 0,
+            "moments": _chip_tree(opt["mu"]) + _chip_tree(opt["nu"]),
+        }
         report = {
             "donated_state_bytes": donated,
+            "donated_bytes_per_chip": donated_chip,
+            "by_category": by_category,
             "available": ma is not None,
         }
-        if self.mesh.size > 1:
-            # memory_analysis sizes are per-executable (per-device shard);
-            # the global state-byte comparison below only lines up on a
-            # single device. Report the numbers, skip the assertion.
+        if jax.process_count() > 1:
             assert_donation = False
             report["note"] = (
-                "sharded state: analysis bytes are per-device; donation "
-                "assertion runs on single-device layouts only"
+                "multi-process run: this process's executable only covers "
+                "its own devices; donation assertion skipped"
             )
         if ma is not None:
             report.update(
@@ -746,16 +1003,18 @@ class Trainer:
                 temp_bytes=int(ma.temp_size_in_bytes),
                 alias_bytes=int(ma.alias_size_in_bytes),
                 unaliased_donated_bytes=max(
-                    0, donated - int(ma.alias_size_in_bytes)
+                    0, donated_chip - int(ma.alias_size_in_bytes)
                 ),
             )
+            by_category["activations"] = int(ma.temp_size_in_bytes)
             if assert_donation and report["unaliased_donated_bytes"] > 0:
                 raise RuntimeError(
                     f"train-step donation leaked a copy: "
-                    f"{report['unaliased_donated_bytes']} of {donated} "
-                    f"donated state bytes were not aliased into the "
-                    f"outputs (alias_size={report['alias_bytes']}); check "
-                    f"for dtype/sharding mismatches between old and new "
+                    f"{report['unaliased_donated_bytes']} of "
+                    f"{donated_chip} donated per-chip state bytes were "
+                    f"not aliased into the outputs "
+                    f"(alias_size={report['alias_bytes']}); check for "
+                    f"dtype/sharding mismatches between old and new "
                     f"state leaves"
                 )
         return report
@@ -820,7 +1079,8 @@ class Trainer:
         if self._poison_jit is None:
             self._poison_jit = jax.jit(
                 make_train_step(
-                    self.cfg, self._schedule, self.mesh, poison=True
+                    self.cfg, self._schedule, self.mesh, poison=True,
+                    zero1=self._zero1,
                 ),
                 donate_argnums=(0,),
             )

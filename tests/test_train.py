@@ -321,7 +321,7 @@ def test_debug_asserts_injected_oob_fails_loudly_in_a2a_layout():
     sorted_a2a shard_map — where checkify cannot reach — a corrupted
     routing index must raise host-side instead of silently dropping
     tokens. Injection: force-fail the moe_route_idx assert site (the
-    fault-injection style of train/fault.py), proving the assert is wired
+    fault-injection style of runtime/fault.py), proving the assert is wired
     into THIS layout's compiled program; the same flag off must train
     cleanly with injection armed (no-op, nothing traced)."""
     from orion_tpu.runtime.asserts import (

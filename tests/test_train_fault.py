@@ -531,19 +531,3 @@ def test_guard_composes_with_grad_accum_bitwise_resume(tmp_path):
     _tree_equal(sa, sb)
 
 
-@slow
-def test_train_fault_shim_deprecation():
-    import importlib
-    import sys
-    import warnings
-
-    sys.modules.pop("orion_tpu.train.fault", None)
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        import orion_tpu.train.fault as shim
-
-        importlib.reload(shim)
-    assert any(issubclass(x.category, DeprecationWarning) for x in w)
-    from orion_tpu.runtime.fault import PreemptionHandler as canonical
-
-    assert shim.PreemptionHandler is canonical

@@ -1,0 +1,367 @@
+"""ZeRO-1 optimizer-state sharding over the dp axis (train.zero1).
+
+The contract (PAPERS.md 2004.13336): reduce-scatter gradients, update only
+the local 1/dp shard of master params + Adam moments, all-gather the
+updated params — with the fp32 legs expressed as sharding constraints
+inside the jit step so losses AND the post-step full (all-gathered)
+param/moment state are bitwise-equal to the unsharded dp baseline, while
+per-chip optimizer-state bytes shrink ~1/dp. The int8 legs
+(train.zero1_quantize; comm.quantized_reduce_scatter / quantized_all_gather
+inside the shard_map wire path) track the baseline within the quantization
+tolerance.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from orion_tpu.config import get_config
+from orion_tpu.runtime.fault import FaultInjector, FaultSpec
+from orion_tpu.train import Trainer
+
+slow = pytest.mark.slow
+
+
+def _cfg(extra=(), preset="tiny", steps=4, tmp_path=None, sub="ck"):
+    over = [
+        "runtime.platform=cpu", f"train.num_steps={steps}",
+        "optimizer.warmup_steps=2", "train.log_interval=1000",
+        "data.batch_size=8",
+    ]
+    if tmp_path is not None:
+        over += [
+            f"checkpoint.directory={tmp_path}/{sub}",
+            "checkpoint.async_save=false",
+            "checkpoint.save_interval_steps=2",
+        ]
+    return get_config(preset, over + list(extra))
+
+
+def _np_state(state):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state)
+
+
+def _tree_bitwise(a, b):
+    flat_a, flat_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    return all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(flat_a, flat_b)
+    )
+
+
+def _run_state(t, steps):
+    state, start = t.restore_or_init()
+    for i in range(start, start + steps):
+        if t.cfg.train.anomaly_guard:
+            state, m = t.train_step(
+                state, t.global_batch(i), np.float32(np.inf)
+            )
+        else:
+            state, m = t.train_step(state, t.global_batch(i))
+    return _np_state(state), float(jax.device_get(m["loss"]))
+
+
+# -- fp32-leg bitwise equivalence -------------------------------------------
+
+
+def test_zero1_losses_and_state_bitwise_vs_dp_baseline():
+    """The acceptance pin: zero1=on losses AND the post-step full
+    (all-gathered) param/moment state are bitwise-equal to the unsharded
+    dp=8 baseline (the clip norm is pinned to the replicated grad layout,
+    so even grad clipping cannot regroup a reduction)."""
+    hb = Trainer(_cfg(["parallel.dp=8"])).fit()
+    hz = Trainer(_cfg(["parallel.dp=8", "train.zero1=true"])).fit()
+    assert [m.loss for m in hb] == [m.loss for m in hz]
+    assert [m.grad_norm for m in hb] == [m.grad_norm for m in hz]
+
+    sb, _ = _run_state(Trainer(_cfg(["parallel.dp=8"], steps=3)), 3)
+    sz, _ = _run_state(
+        Trainer(_cfg(["parallel.dp=8", "train.zero1=true"], steps=3)), 3
+    )
+    assert _tree_bitwise(sb, sz)
+
+
+def test_zero1_state_is_physically_dp_sharded():
+    """The moments really live 1/dp per device (the memory lever is the
+    sharding, not the collective choice): mu/nu shard specs carry 'dp'
+    and each device's local shard is 1/dp of the global leaf."""
+    t = Trainer(_cfg(["parallel.dp=8", "train.zero1=true"], steps=1))
+    state, _ = t.restore_or_init()
+    mu = state["opt"]["mu"]["embed"]["tokens"]
+    assert "dp" in tuple(mu.sharding.spec)
+    local = mu.addressable_shards[0].data
+    assert local.size * 8 == mu.size
+    # Params stay replicated (the forward needs them whole).
+    p = state["params"]["embed"]["tokens"]
+    assert p.addressable_shards[0].data.size == p.size
+
+
+def test_zero1_composes_bitwise_with_accum_scan_group_remat():
+    """The acceptance compositions: grad_accum, scan_group and
+    remat=names ride the zero1 step unchanged — losses stay bitwise-equal
+    to the same-composition unsharded baseline."""
+    extra = ("data.batch_size=16", "train.grad_accum=2",
+             "model.scan_group=2", "train.remat=names")
+    hb = Trainer(_cfg(["parallel.dp=8", *extra])).fit()
+    hz = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true", *extra])
+    ).fit()
+    assert [m.loss for m in hb] == [m.loss for m in hz]
+
+
+def test_zero1_guard_bitwise_and_nan_skip():
+    """anomaly_guard composes: healthy steps bitwise-match the guarded
+    baseline, and a NaN-poisoned step is skipped with the dp-sharded
+    state coming back bit-identical to the pre-step state."""
+    hb = Trainer(
+        _cfg(["parallel.dp=8", "train.anomaly_guard=true"])
+    ).fit()
+    hz = Trainer(
+        _cfg(["parallel.dp=8", "train.anomaly_guard=true",
+              "train.zero1=true"])
+    ).fit()
+    assert [m.loss for m in hb] == [m.loss for m in hz]
+
+    inj = FaultInjector(
+        specs=[FaultSpec(kind="nan", step=2, path="train")]
+    )
+    t = Trainer(
+        _cfg(["parallel.dp=8", "train.anomaly_guard=true",
+              "train.zero1=true", "train.anomaly_limit=5"]),
+        fault_injector=inj,
+    )
+    hist = t.fit()
+    assert t.robustness.anomalous_steps == 1
+    assert not np.isfinite(hist[2].loss)       # poisoned step logged...
+    assert np.isfinite(hist[-1].loss)          # ...but never entered state
+
+
+def test_zero1_memory_report_shrinks_moments_one_over_dp():
+    """Trainer.memory_report(): per-chip moment bytes shrink ~1/dp for
+    dp in {2,4,8} with every donated byte still aliased (dims that cannot
+    split dp-ways stay replicated, so the shrink is <= exact 1/dp but
+    must be within a leaf of it for this model)."""
+    base = Trainer(_cfg([], steps=1)).memory_report(assert_donation=True)
+    full = base["by_category"]["moments"]
+    for dp in (2, 4, 8):
+        t = Trainer(
+            _cfg([f"parallel.dp={dp}", "train.zero1=true"], steps=1)
+        )
+        r = t.memory_report(assert_donation=True)
+        cat = r["by_category"]
+        assert r["unaliased_donated_bytes"] == 0
+        assert cat["moments"] == full // dp, (dp, cat)
+        assert cat["params"] == base["by_category"]["params"]
+        assert cat["master"] == 0      # param_dtype == dtype: no split
+
+
+def test_zero1_master_split_bf16_working_copy():
+    """With model.dtype=bfloat16 the state splits: params become the
+    cast-down bf16 working copy (replicated — the forward reads them)
+    and opt carries the dp-sharded f32 master; memory_report shows
+    master+moments at 1/dp and params at half the f32 bytes."""
+    t = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true",
+              "model.dtype=bfloat16"])
+    )
+    state, _ = t.restore_or_init()
+    assert "master" in state["opt"]
+    p = state["params"]["embed"]["tokens"]
+    m = state["opt"]["master"]["embed"]["tokens"]
+    assert p.dtype == jnp.bfloat16 and m.dtype == jnp.float32
+    # Master shard bytes = f32 params / dp; working copy = bf16 replicated.
+    r = t.memory_report(assert_donation=True)
+    cat = r["by_category"]
+    assert cat["master"] == cat["params"] // 4  # (4B/dp=8) vs 2B => /4
+    assert r["unaliased_donated_bytes"] == 0
+    hist = t.fit()
+    assert np.isfinite(hist[-1].loss)
+
+
+# -- int8 wire legs ----------------------------------------------------------
+
+
+def test_zero1_int8_tracks_baseline():
+    """Both legs int8 (the DCN-wire configuration): losses track the
+    unsharded baseline within the blockwise-quantization tolerance over a
+    short run — the documented loss-curve parity check."""
+    hb = Trainer(_cfg(["parallel.dp=8"], steps=6)).fit()
+    hi = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true",
+              "train.zero1_quantize=int8"], steps=6)
+    ).fit()
+    for a, b in zip(hb, hi):
+        np.testing.assert_allclose(b.loss, a.loss, rtol=5e-3, atol=5e-3)
+    assert hi[-1].loss < hi[0].loss  # and it actually trains
+
+
+def test_zero1_int8_per_leg_selection():
+    """train.zero1_quantize=rs_int8 / ag_int8 quantize exactly one wire
+    leg; both run and track the fp32 zero1 trajectory closely."""
+    ref = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true"], steps=3)
+    ).fit()
+    for mode in ("rs_int8", "ag_int8"):
+        h = Trainer(
+            _cfg(["parallel.dp=8", "train.zero1=true",
+                  f"train.zero1_quantize={mode}"], steps=3)
+        ).fit()
+        for a, b in zip(ref, h):
+            np.testing.assert_allclose(
+                b.loss, a.loss, rtol=5e-3, atol=5e-3
+            ), mode
+
+
+def test_zero1_int8_ag_carries_master_even_at_same_dtype():
+    """A quantized all-gather leg forces the master split even when
+    param_dtype == dtype: without it the owner's own shard would re-enter
+    the next update int8-roundtripped — a compounding per-step error
+    random walk. With the master, the update always reads the exact
+    master shards and params are a bounded ONE-step quantization of them.
+    An rs-only int8 leg keeps the exact all-gather and needs no master."""
+    t = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true",
+              "train.zero1_quantize=int8"], steps=1)
+    )
+    state, _ = t.restore_or_init()
+    assert "master" in state["opt"]
+    assert (state["opt"]["master"]["embed"]["tokens"].dtype
+            == state["params"]["embed"]["tokens"].dtype)
+    t2 = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true",
+              "train.zero1_quantize=rs_int8"], steps=1)
+    )
+    s2, _ = t2.restore_or_init()
+    assert "master" not in s2["opt"]
+
+
+@slow
+def test_zero1_int8_guard_skips_poisoned_step():
+    """The manual (shard_map) path checks finiteness on the LOCAL partial
+    grads — before the int8 leg could round a NaN away — so the guard
+    still skips a poisoned step under zero1_quantize=int8."""
+    inj = FaultInjector(
+        specs=[FaultSpec(kind="nan", step=2, path="train")]
+    )
+    t = Trainer(
+        _cfg(["parallel.dp=8", "train.zero1=true",
+              "train.zero1_quantize=int8", "train.anomaly_guard=true",
+              "train.anomaly_limit=5"]),
+        fault_injector=inj,
+    )
+    hist = t.fit()
+    assert t.robustness.anomalous_steps == 1
+    assert np.isfinite(hist[-1].loss)
+
+
+# -- checkpoint topology conversion -----------------------------------------
+
+
+def test_zero1_ckpt_saves_sharded_and_restores_across_dp(tmp_path):
+    """dp-sharded optimizer state rides the existing checkpoint path: the
+    manifest records the dp sharding, the saved full state round-trips
+    bitwise onto dp=2 (zero1) and dp=1 (zero1 off — same leaf set, the
+    masterless layout matches the baseline tree), and one further step at
+    the new degree is bitwise-equal to a dp=2 baseline that never ran
+    zero1 (cross-degree steps regroup the batch reduction, so the
+    never-resharded dp=4 continuation is pinned allclose, not bitwise)."""
+    import json
+
+    t4 = Trainer(
+        _cfg(["parallel.dp=4", "train.zero1=true"], steps=2,
+             tmp_path=tmp_path)
+    )
+    t4.fit()
+    saved, _ = t4.ckpt.restore_latest(t4.abstract_state())
+    saved = _np_state(saved)
+
+    ckdir = f"{tmp_path}/ck"
+    newest = sorted(
+        d for d in os.listdir(ckdir) if d.startswith("step_")
+    )[-1]
+    man = json.load(open(os.path.join(ckdir, newest, "manifest.json")))
+    mu_key = next(k for k in man["leaves"] if "'mu'" in k and "tokens" in k)
+    assert "dp" in (man["leaves"][mu_key]["sharding"] or [])
+
+    # Round-trip restore at other dp degrees is bitwise.
+    for extra in (["parallel.dp=2", "train.zero1=true"], []):
+        t = Trainer(_cfg(extra, steps=3, tmp_path=tmp_path))
+        restored, step = t.ckpt.restore_latest(t.abstract_state())
+        assert step == 2
+        assert _tree_bitwise(saved, _np_state(restored))
+
+    # One further step at dp=2: zero1 == baseline bitwise at equal degree.
+    s2, l2 = _run_state(
+        Trainer(_cfg(["parallel.dp=2", "train.zero1=true"], steps=3,
+                     tmp_path=tmp_path)), 1
+    )
+    s2b, l2b = _run_state(
+        Trainer(_cfg(["parallel.dp=2"], steps=3, tmp_path=tmp_path)), 1
+    )
+    assert l2 == l2b and _tree_bitwise(s2, s2b)
+    # Never-resharded dp=4 continuation: same trajectory within ULPs.
+    s4, l4 = _run_state(
+        Trainer(_cfg(["parallel.dp=4", "train.zero1=true"], steps=3,
+                     tmp_path=tmp_path)), 1
+    )
+    np.testing.assert_allclose(l4, l2, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s4), jax.tree.leaves(s2)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+# -- plumbing ----------------------------------------------------------------
+
+
+def test_zero1_update_dim_choice():
+    """zero1_update_dim: largest divisible unsharded dim wins, ties break
+    low, already-sharded dims are excluded, -1 when nothing fits."""
+    from orion_tpu.parallel import zero1_update_dim
+
+    assert zero1_update_dim((6, 16, 8), P(None, None, None), 8) == 1
+    assert zero1_update_dim((16, 16), P(None, None), 8) == 0     # tie: low
+    assert zero1_update_dim((16, 8), P("fsdp", None), 8) == 1    # excluded
+    assert zero1_update_dim((6, 7), P(None, None), 8) is None
+    assert zero1_update_dim((64,), P(None,), 8) == 0
+
+
+def test_zero1_validation():
+    with pytest.raises(ValueError, match="dp > 1"):
+        Trainer(_cfg(["train.zero1=true"]))
+    with pytest.raises(ValueError, match="stage-local dp"):
+        Trainer(_cfg(["train.zero1=true", "parallel.pp=2",
+                      "parallel.dp=2"]))
+    with pytest.raises(ValueError, match="without train.zero1"):
+        Trainer(_cfg(["train.zero1_quantize=int8"]))
+    with pytest.raises(ValueError, match="grad_quant_bits"):
+        Trainer(_cfg(["train.zero1=true", "parallel.dp=2",
+                      "train.grad_quant_bits=8"]))
+    with pytest.raises(ValueError, match="pure DP"):
+        Trainer(_cfg(["train.zero1=true", "parallel.dp=2",
+                      "parallel.tp=2", "train.zero1_quantize=int8"]))
+    with pytest.raises(ValueError, match="rs_int8"):
+        get_config("tiny", ["train.zero1_quantize=int4"])
+    # The int8 path is a manual shard_map region: checkify must reject it
+    # with the reason, like every other manual layout.
+    with pytest.raises(ValueError, match="shard_map"):
+        Trainer(_cfg(["train.zero1=true", "parallel.dp=2",
+                      "train.zero1_quantize=int8",
+                      "runtime.checkify=true"]))
+
+
+@slow
+def test_zero1_fsdp_composition_bitwise():
+    """zero1 composes with fsdp: the update dim avoids the fsdp-sharded
+    embed axis and losses stay bitwise vs the same-layout baseline."""
+    hb = Trainer(
+        _cfg(["parallel.dp=4", "parallel.fsdp=2"], preset="tiny-llama")
+    ).fit()
+    hz = Trainer(
+        _cfg(["parallel.dp=4", "parallel.fsdp=2", "train.zero1=true"],
+             preset="tiny-llama")
+    ).fit()
+    assert [m.loss for m in hb] == [m.loss for m in hz]

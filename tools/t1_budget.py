@@ -14,8 +14,16 @@ invoked with ``--durations=N``) the slowest-test table, prints the top-20
 slowest tests and the total against the budget, and exits nonzero when the
 total exceeds the budget (or ``--warn-frac`` of it with ``--strict-warn``).
 
+``--diff PREV_LOG`` additionally compares per-test durations against a
+previous run's log and fails on any individual test that regressed more
+than ``--diff-factor`` (default 2x) past the ``--diff-floor`` noise floor
+(default 1 s) — so a slow new composition (a zero1 x scan_group x remat
+case, say) is caught at the TEST level before it saturates the suite-level
+budget. Tests present only in the new log are listed, not failed.
+
 Run the tier-1 command with ``--durations=25`` appended to get the
-per-test breakdown; without it the tool still checks the total.
+per-test breakdown; without it the tool still checks the total (and
+``--diff`` can only compare tests both tables mention).
 """
 
 from __future__ import annotations
@@ -47,6 +55,33 @@ def parse_log(text: str):
     return summary, total, durations
 
 
+def diff_durations(
+    prev: list, cur: list, factor: float, floor: float
+) -> tuple[list, list]:
+    """Compare per-test call durations between two logs.
+
+    Returns (regressions, new_tests): regressions are (test, prev_s,
+    cur_s) rows where the call time grew more than ``factor``x AND past
+    the ``floor`` (sub-floor times are timing noise on a loaded CI box);
+    new_tests are tests only the current table mentions — informational,
+    since a --durations table only covers the N slowest.
+    """
+    prev_by = {t: s for s, phase, t in prev if phase == "call"}
+    regressions, new_tests = [], []
+    for s, phase, t in cur:
+        if phase != "call":
+            continue
+        if t not in prev_by:
+            if s >= floor:
+                new_tests.append((t, s))
+            continue
+        p = prev_by[t]
+        if s >= floor and s > p * factor:
+            regressions.append((t, p, s))
+    regressions.sort(key=lambda r: r[2] - r[1], reverse=True)
+    return regressions, new_tests
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("log", nargs="?", default="/tmp/_t1.log",
@@ -59,6 +94,14 @@ def main(argv=None) -> int:
                     help="warn when total exceeds this fraction of budget")
     ap.add_argument("--strict-warn", action="store_true",
                     help="exit nonzero on the warn threshold too")
+    ap.add_argument("--diff", metavar="PREV_LOG", default=None,
+                    help="previous run's log: fail on per-test "
+                         "regressions past --diff-factor")
+    ap.add_argument("--diff-factor", type=float, default=2.0,
+                    help="per-test regression factor (default 2x)")
+    ap.add_argument("--diff-floor", type=float, default=1.0,
+                    help="ignore tests under this many seconds "
+                         "(timing noise; default 1.0)")
     args = ap.parse_args(argv)
 
     try:
@@ -84,6 +127,45 @@ def main(argv=None) -> int:
         print("no --durations table in the log; append --durations=25 to "
               "the tier-1 pytest command for the per-test breakdown")
 
+    diff_failed = False
+    if args.diff:
+        try:
+            prev_text = open(args.diff, errors="replace").read()
+        except OSError as e:
+            print(f"t1_budget: cannot read --diff log {args.diff}: {e}",
+                  file=sys.stderr)
+            return 2
+        _, prev_total, prev_durations = parse_log(prev_text)
+        if not durations or not prev_durations:
+            print("t1_budget: --diff needs --durations tables in BOTH "
+                  "logs; skipping the per-test comparison",
+                  file=sys.stderr)
+        else:
+            regressions, new_tests = diff_durations(
+                prev_durations, durations,
+                args.diff_factor, args.diff_floor,
+            )
+            if new_tests:
+                print(f"\n{len(new_tests)} test(s) not in the previous "
+                      f"table (new, or newly slow enough to chart):")
+                for t, s in new_tests[:10]:
+                    print(f"  {s:8.2f}s  {t}")
+            if regressions:
+                diff_failed = True
+                print(f"\nt1_budget: {len(regressions)} test(s) regressed "
+                      f">{args.diff_factor:g}x vs {args.diff}:",
+                      file=sys.stderr)
+                for t, p, s in regressions:
+                    print(f"  {p:8.2f}s -> {s:8.2f}s "
+                          f"({s / max(p, 1e-9):.1f}x)  {t}",
+                          file=sys.stderr)
+            else:
+                print("\nno per-test regressions vs "
+                      f"{args.diff} (factor {args.diff_factor:g}x, "
+                      f"floor {args.diff_floor:g}s)")
+            if prev_total is not None:
+                print(f"total: {prev_total:.1f}s -> {total:.1f}s")
+
     frac = total / args.budget
     print(f"\n{summary}")
     print(f"total: {total:.1f}s of {args.budget:.0f}s budget "
@@ -91,6 +173,9 @@ def main(argv=None) -> int:
     if total > args.budget:
         print("t1_budget: OVER BUDGET — the tier-1 gate's timeout will "
               "kill this suite", file=sys.stderr)
+        return 1
+    if diff_failed:
+        # Caught at the test level, before the suite-level budget breaks.
         return 1
     if frac > args.warn_frac:
         print(f"t1_budget: WARNING — past {args.warn_frac * 100:.0f}% of "

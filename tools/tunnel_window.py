@@ -41,8 +41,12 @@ QUEUE = [
     # probe with its own compile budget (bench.TRAIN_PROBES): supersedes
     # tools/scan_probe.py in the queue — same subprocess-budget discipline,
     # plus the scan_group x remat=names grid this round's PERF.md asks for.
+    # The ZeRO-1 probes (zero1 / zero1_int8 / zero1_scan_group4_names,
+    # ISSUE 10) ride the same `--probe all`; on a 1-chip window they
+    # record a fast config error, on a >=4-chip window they measure.
+    # Budget sized for the full 12-probe grid's worst case.
     ("bench_probes",
-     [sys.executable, str(ROOT / "bench.py"), "--probe", "all"], 9000),
+     [sys.executable, str(ROOT / "bench.py"), "--probe", "all"], 12600),
     ("moe_dispatch",
      [sys.executable, str(ROOT / "tools/moe_dispatch_bench.py")], 1800),
     ("longcontext",
