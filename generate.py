@@ -55,6 +55,12 @@ def main(argv: list[str] | None = None) -> int:
                              "distribution; sugar for "
                              "inference.speculative=true + "
                              "inference.speculate_tokens=N")
+    parser.add_argument("--spec-tree", type=int, default=None, metavar="W",
+                        help="token-TREE speculation: draft up to W "
+                             "distinct n-gram continuations per step and "
+                             "verify the whole branch tree in one "
+                             "dispatch (requires --speculate); sugar for "
+                             "inference.spec_tree_width=W")
     parser.add_argument("--trace", metavar="PATH", default=None,
                         help="export a Chrome trace-event JSON of the "
                              "serve to PATH (request-lifecycle spans + "
@@ -102,6 +108,14 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(f"--speculate must be >= 1, got {args.speculate}")
         overrides.append("inference.speculative=true")
         overrides.append(f"inference.speculate_tokens={args.speculate}")
+    if args.spec_tree is not None:
+        if args.speculate is None:
+            raise SystemExit("--spec-tree requires --speculate N")
+        if args.spec_tree < 1:
+            raise SystemExit(
+                f"--spec-tree must be >= 1, got {args.spec_tree}"
+            )
+        overrides.append(f"inference.spec_tree_width={args.spec_tree}")
     if args.trace is not None:
         overrides.append("inference.trace=true")
         overrides.append(f"inference.trace_path={args.trace}")

@@ -677,6 +677,23 @@ class InferenceConfig:
     # most recent match is the draft.
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # Token-TREE speculation width (1 = single-path chain drafting, the
+    # default and the pre-tree behavior bit-for-bit). With width w > 1
+    # the proposer collects up to w DISTINCT n-gram continuations per
+    # request (context matches across n values + prefix-cache token
+    # paths) and merges them into a token trie of at most
+    # speculate_tokens nodes; one verify dispatch scores every branch
+    # under a packed ancestor mask (the ragged kernel's intra-slot
+    # causal mask generalized), the engine accepts the longest verified
+    # root-path, compacts its KV into cursor-contiguous slots and rolls
+    # back only the losing branches' pages. Depth stays acceptance-
+    # adaptive (SpecState): on traffic where the single path keeps
+    # missing, the halved depth frees verify-width for siblings —
+    # breadth exactly where chains stall. Greedy output stays
+    # byte-identical to spec-off; the chain-degenerate tree is bitwise
+    # today's verify. Requires speculate_tokens + 1 <= 31 (int32 mask
+    # words) and spec_tree_width <= speculate_tokens.
+    spec_tree_width: int = 1
     # Draft-density gate: enter a verify step only when at least this
     # many live decode slots actually drafted (clamped to the live count,
     # so a fully-drafting batch always verifies). A step where ANY slot
@@ -773,6 +790,11 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.default_deadline_s={self.default_deadline_s} "
                 f"must be > 0 (or none)"
+            )
+        if self.spec_tree_width is None or self.spec_tree_width < 1:
+            raise ValueError(
+                f"inference.spec_tree_width={self.spec_tree_width} must "
+                f"be >= 1 (1 = chain drafting)"
             )
         if self.spec_fault_limit is None or self.spec_fault_limit < 1:
             raise ValueError(

@@ -374,6 +374,7 @@ class InferenceEngine:
         # adaptive draft lengths ride the `lens` argument, so there is
         # one jit specialization, not one per draft-length mix.
         self._spec = None
+        self._tree = False          # token-tree drafting (spec_tree_width>1)
         self.spec_stats = SpecDecodeStats()
         self._spec_step = False     # this step ran verify, not decode
         self._autotune_skip = False  # first step after a window resize
@@ -409,11 +410,47 @@ class InferenceEngine:
                     kv_quant=self.icfg.kv_quant,
                     dtype_itemsize=jnp.dtype(self.mcfg.dtype).itemsize,
                 )
+            if self.icfg.spec_tree_width > self.icfg.speculate_tokens:
+                raise ValueError(
+                    f"inference.spec_tree_width="
+                    f"{self.icfg.spec_tree_width} exceeds "
+                    f"speculate_tokens={self.icfg.speculate_tokens}: a "
+                    f"tree of w branches needs at least w nodes"
+                )
+            if (
+                self.icfg.spec_tree_width > 1
+                and self.icfg.speculate_tokens + 1 > 31
+            ):
+                raise ValueError(
+                    f"tree speculation packs the per-column ancestor mask "
+                    f"into int32 words: speculate_tokens="
+                    f"{self.icfg.speculate_tokens} needs "
+                    f"{self.icfg.speculate_tokens + 1} columns > the "
+                    f"31-bit budget; lower inference.speculate_tokens or "
+                    f"set spec_tree_width=1"
+                )
             self._spec = NgramProposer(
                 speculate_tokens=self.icfg.speculate_tokens,
                 max_n=self.icfg.spec_ngram_max,
                 min_n=self.icfg.spec_ngram_min,
+                tree_width=self.icfg.spec_tree_width,
             )
+            # Token trees (inference.spec_tree_width > 1): the accepted
+            # root-path may live at non-contiguous verify columns; this
+            # program moves its KV into cursor-contiguous slots before
+            # the losing branches roll back (kv_cache.compact_draft_kv).
+            self._tree = self.icfg.spec_tree_width > 1
+            if self._tree:
+                from orion_tpu.infer.kv_cache import compact_draft_kv
+
+                self._compact = jax.jit(
+                    partial(
+                        compact_draft_kv,
+                        n_layers=self.mcfg.n_layers,
+                        num_pages=self.icfg.num_pages,
+                    ),
+                    donate_argnums=(0,),
+                )
             self._verify = self._jit_program("verify", self.mcfg, self.mesh)
             self._verify_defaults = self._jit_program(
                 "verify_defaults", self.mcfg, self.mesh
@@ -559,7 +596,7 @@ class InferenceEngine:
             self._xla_fallbacks[name] = fb
         return fb
 
-    def _run_dispatch(self, path: str, name: str, *args):
+    def _run_dispatch(self, path: str, name: str, *args, **kwargs):
         """Run one device dispatch with the fault-tolerance envelope: the
         injection points (stall sleeps; dispatch exceptions raised BEFORE
         the primary call, so engine/cache state is untouched and retry is
@@ -596,7 +633,7 @@ class InferenceEngine:
             # that window): names this dispatch in a concurrently-captured
             # device profile so xprof rows align with the Chrome export.
             with self._tracer.annotation("orion/" + path):
-                out = getattr(self, "_" + name)(*args)
+                out = getattr(self, "_" + name)(*args, **kwargs)
                 jax.block_until_ready(out)
             return out
         except Exception as e:
@@ -624,7 +661,7 @@ class InferenceEngine:
             )
             try:
                 with self._tracer.annotation("orion/" + path + "/fallback"):
-                    out = fb(*args)
+                    out = fb(*args, **kwargs)
                     jax.block_until_ready(out)
             except Exception as e2:
                 self.robust.dispatch_faults += 1
@@ -1987,10 +2024,14 @@ class InferenceEngine:
                 self.icfg.max_seq_len - 1 - pos,
                 r.max_new_tokens - len(r.generated) - 1,
             )
-            d = (
-                self._spec.propose(r.rid, r.context, limit, extra)
-                if limit > 0 else []
-            )
+            if limit <= 0:
+                d = None if self._tree else []
+            elif self._tree:
+                # Token-tree drafting: up to spec_tree_width distinct
+                # n-gram continuations merged into a trie (DraftTree).
+                d = self._spec.propose_tree(r.rid, r.context, limit, extra)
+            else:
+                d = self._spec.propose(r.rid, r.context, limit, extra)
             drafts[r.slot] = d
             n_drafted += bool(d)
         if not n_drafted:
@@ -2020,6 +2061,44 @@ class InferenceEngine:
             lens[r.slot] = 1 + len(d)
         return tokens, lens
 
+    def _build_verify_tree_rows(
+        self, reqs: list[Request], drafts: dict[int, Any]
+    ) -> tuple[np.ndarray, ...]:
+        """Tree-mode verify layout (inference.spec_tree_width > 1): the
+        chain row layout plus the flattened DraftTree structure arrays —
+        per-column tree depths, parent columns, and packed ancestor mask
+        words. Columns without a node (padding, and whole rows without a
+        tree) carry CHAIN-shaped defaults (depth j, parent j-1, causal
+        prefix words), so a chain-shaped tree feeds the device arrays a
+        pure chain would — the degenerate case is bitwise today's
+        verify."""
+        W = self.icfg.speculate_tokens + 1
+        B = self.max_batch
+        steps = np.arange(W, dtype=np.int64)
+        tokens = np.zeros((B, W), np.int32)
+        lens = np.ones(B, np.int32)
+        depths = np.tile(steps.astype(np.int32), (B, 1))
+        parents = np.tile(
+            np.maximum(steps - 1, 0).astype(np.int32), (B, 1)
+        )
+        words = np.tile(
+            ((np.int64(1) << (steps + 1)) - 1).astype(np.int32), (B, 1)
+        )
+        for r in reqs:
+            s = r.slot
+            t = drafts.get(s)
+            tokens[s, 0] = self.last_token[s]
+            if t:
+                n = len(t)
+                tokens[s, 1:1 + n] = t.tokens
+                lens[s] = 1 + n
+                depths[s, :1 + n] = t.depths()
+                parents[s, 1:1 + n] = t.parents
+                words[s, :1 + n] = np.asarray(
+                    t.mask_words(), np.int64
+                ).astype(np.int32)
+        return tokens, lens, depths, parents, words
+
     def _verify_all(self, drafts: dict[int, list[int]]) -> bool:
         """One verify dispatch for every live decode slot: K drafts + the
         pending last token per slot, scored in a single pass over the
@@ -2038,7 +2117,18 @@ class InferenceEngine:
             # window instead (it re-provisions to the decode window).
             self._spec_step = False
             return self._decode_window_all()
-        tokens, lens = self._build_verify_rows(active, drafts)
+        if self._tree:
+            tokens, lens, depths, parents, words = (
+                self._build_verify_tree_rows(active, drafts)
+            )
+            tree_kw = dict(
+                depths=jnp.asarray(depths),
+                parents=jnp.asarray(parents),
+                tree_mask=jnp.asarray(words),
+            )
+        else:
+            tokens, lens = self._build_verify_rows(active, drafts)
+            tree_kw = {}
         mask = np.zeros(self.max_batch, bool)
         for r in active:
             mask[r.slot] = True
@@ -2058,13 +2148,16 @@ class InferenceEngine:
                 r.temperature is None and r.top_k is None and r.top_p is None
                 for r in active
             ):
-                out = self._run_dispatch("verify", "verify_defaults", *common)
+                out = self._run_dispatch(
+                    "verify", "verify_defaults", *common, **tree_kw
+                )
             else:
                 out = self._run_dispatch(
                     "verify", "verify", *common,
                     jnp.asarray(self.slot_temp),
                     jnp.asarray(self.slot_top_k),
                     jnp.asarray(self.slot_top_p),
+                    **tree_kw,
                 )
             if self._guard:
                 acc, alt, ok, self.cache = out
@@ -2079,7 +2172,11 @@ class InferenceEngine:
                 if not okh[req.slot]:
                     self._quarantine(req, "nan")
             active = [r for r in active if r.slot is not None]
-        self._accept_and_rollback(active, tokens, lens, acc, alt)
+        if self._tree:
+            self._accept_and_rollback_tree(active, tokens, lens, drafts,
+                                           acc, alt)
+        else:
+            self._accept_and_rollback(active, tokens, lens, acc, alt)
         self._reap()
         return True
 
@@ -2139,6 +2236,140 @@ class InferenceEngine:
         if len(req.pages) > n_keep:
             rollback_pages(self.alloc, req.pages, n_keep)
             self.page_table[req.slot, n_keep:] = 0
+
+    def _plan_emission(self, req: Request, emit: list[int]) -> int:
+        """How many of ``emit``'s tokens this request will actually
+        accept — a side-effect-free mirror of the emission loop's
+        ``_maybe_finish`` stop conditions, so tree acceptance can size
+        the KV compaction BEFORE any engine state mutates (a failed
+        compaction dispatch then fails the step with nothing emitted,
+        the same containment contract every other dispatch has)."""
+        n = 0
+        gen = len(req.generated)
+        pos = int(self.seq_lens[req.slot])
+        for tok in emit:
+            n += 1
+            gen += 1
+            pos += 1
+            if (
+                (self.eos_id is not None and tok == self.eos_id)
+                or pos >= self.icfg.max_seq_len
+                or gen >= req.max_new_tokens
+            ):
+                break
+        return n
+
+    def _accept_and_rollback_tree(
+        self,
+        active: list[Request],
+        tokens: np.ndarray,
+        lens: np.ndarray,
+        drafts: dict[int, Any],
+        acc: np.ndarray,
+        alt: np.ndarray,
+    ) -> None:
+        """Tree-mode acceptance: walk each slot's DraftTree root-down,
+        descending into the first accepted child in sibling (insertion-
+        priority) order — greedy rows can match at most one sibling
+        (tokens are distinct), sampled rows' verdicts are the
+        sequential multi-branch rejection scheme of
+        ``sampling.spec_verify_sample_tree`` — and emit the verified
+        path plus the final node's bonus/correction token.
+
+        An accepted path that is not the tree's primary chain lives at
+        non-contiguous verify columns; its KV is MOVED into
+        cursor-contiguous slots in one batched compaction dispatch
+        (kv_cache.compact_draft_kv) before anything else runs — the
+        primary-chain case (and all chain-shaped traffic) needs no
+        dispatch at all. Then the cursor advances by emissions exactly
+        as the chain walk's does, and rollback releases every page
+        covering only losing-branch positions, restoring the window=1
+        footprint."""
+        st = self.spec_stats
+        st.verify_steps += 1
+        st.verify_slot_steps += len(active)
+        W = self.icfg.speculate_tokens + 1
+        src = np.tile(np.arange(W, dtype=np.int32), (self.max_batch, 1))
+        plans: list[tuple[Request, Any, list[int], list[int]]] = []
+        moves = 0
+        for r in active:
+            s = r.slot
+            t = drafts.get(s) or None
+            path: list[int] = []
+            cur = 0
+            if t is not None:
+                children = t.children()
+                while True:
+                    nxt = next(
+                        (c for c in children[cur] if acc[s, c]), None
+                    )
+                    if nxt is None:
+                        break
+                    path.append(nxt)
+                    cur = nxt
+            emit = [int(tokens[s, c]) for c in path] + [int(alt[s, cur])]
+            plans.append((r, t, path, emit))
+            kept = min(self._plan_emission(r, emit), len(path))
+            off = [i for i in range(kept) if path[i] != i + 1]
+            if off:
+                src[s, 1:1 + kept] = path[:kept]
+                moves += len(off)
+        if moves:
+            try:
+                with self._device_span("compact"), \
+                        self._tracer.annotation("orion/compact"):
+                    self.cache = self._compact(
+                        self.cache,
+                        jnp.asarray(self.page_table),
+                        jnp.asarray(self.seq_lens),
+                        jnp.asarray(src),
+                    )
+                    jax.block_until_ready(self.cache)
+            except Exception as e:
+                self.robust.dispatch_faults += 1
+                self._flight_note(
+                    "dispatch_fault", path="compact",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                # A broken compaction program is a speculation-path
+                # fault: count it toward the auto-disable ladder so a
+                # persistent failure turns speculation off instead of
+                # escalating to the max_step_faults re-raise.
+                self._note_spec_fault(e)
+                raise DispatchFault(
+                    "compact", f"{type(e).__name__}: {e}"
+                ) from e
+            st.compactions += 1
+            st.compacted_tokens += moves
+        for r, t, path, emit in plans:
+            s = r.slot
+            n_emit = 0
+            for tok in emit:
+                if r.done:
+                    break
+                self.seq_lens[s] += 1
+                self.last_token[s] = tok
+                r.generated.append(tok)
+                n_emit += 1
+                self._maybe_finish(r, tok)
+            kept = min(n_emit, len(path))
+            k = int(lens[s]) - 1
+            depth = t.max_depth if t is not None else 0
+            st.drafted += k
+            st.accepted += kept
+            st.rolled_back += k - kept
+            st.emitted += n_emit
+            st.tree_nodes += k
+            st.tree_branch_nodes += max(k - depth, 0)
+            # The adaptive controller steers DEPTH (the chain-equivalent
+            # draft length): drafted = the tree's primary depth, accepted
+            # = the verified path length. Width fills whatever budget the
+            # depth leaves (spec_decode.NgramProposer.propose_tree).
+            self._spec.state(r.rid).update(
+                depth, kept, self.icfg.speculate_tokens
+            )
+            if not r.done:
+                self._rollback_slot(r)
 
     def _decode_all(self) -> bool:
         self._roll_window()
@@ -2351,7 +2582,18 @@ class InferenceEngine:
             # decode rows (runner.mixed_verify_step); prompt-phase slots
             # are plain chunk rows, exactly as without speculation.
             self._spec_step = True
-            vtok, vlens = self._build_verify_rows(dec, drafts)
+            if self._tree:
+                vtok, vlens, vdepths, vparents, vwords = (
+                    self._build_verify_tree_rows(dec, drafts)
+                )
+                tree_kw = dict(
+                    depths=jnp.asarray(vdepths),
+                    parents=jnp.asarray(vparents),
+                    tree_mask=jnp.asarray(vwords),
+                )
+            else:
+                vtok, vlens = self._build_verify_rows(dec, drafts)
+                tree_kw = {}
             common = (
                 self.params,
                 self.cache,
@@ -2365,12 +2607,13 @@ class InferenceEngine:
             with self._device_span("mixed_verify"):
                 if defaults:
                     out = self._run_dispatch(
-                        "mixed_verify", "mixed_verify_defaults", *common
+                        "mixed_verify", "mixed_verify_defaults", *common,
+                        **tree_kw
                     )
                 else:
                     out = self._run_dispatch(
                         "mixed_verify", "mixed_verify", *common,
-                        *override_args
+                        *override_args, **tree_kw
                     )
                 if self._guard:
                     acc, alt, ok, p_logits, self.cache = out
@@ -2449,7 +2692,12 @@ class InferenceEngine:
                     self._quarantine(r, "nan")
             dec = [r for r in dec if r.slot is not None]
         if drafts is not None:
-            self._accept_and_rollback(dec, vtok, vlens, acc, alt)
+            if self._tree:
+                self._accept_and_rollback_tree(
+                    dec, vtok, vlens, drafts, acc, alt
+                )
+            else:
+                self._accept_and_rollback(dec, vtok, vlens, acc, alt)
         else:
             for r in dec:
                 tok = int(d_out[r.slot])

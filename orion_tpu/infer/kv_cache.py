@@ -198,6 +198,62 @@ def rollback_pages(
     return dead
 
 
+def compact_draft_kv(
+    cache: Cache,
+    page_table: jax.Array,    # [B, P] int32 per-layer-relative page ids
+    seq_lens: jax.Array,      # [B] int32: the verify-time cursor (start)
+    src: jax.Array,           # [B, W] int32: column whose KV moves to
+    #                           position start + i (identity = no move)
+    *,
+    n_layers: int,
+    num_pages: int,
+) -> Cache:
+    """Tree-speculation KV compaction: move accepted off-path draft KV
+    into cursor-contiguous positions.
+
+    A verify step writes tree column j's K/V at pool position
+    ``start + j``; an accepted root-path of depth d consists of columns
+    ``path[1..d]``, which are slot-contiguous ONLY when the accepted path
+    is the tree's first inserted chain. For any other branch, position
+    ``start + i`` must end up holding column ``path[i]``'s KV before the
+    next decode step reads it. This gathers every (b, i) source entry
+    (position ``start + src[b, i]``) across ALL layers and cache arrays
+    (int8 pools move with their scale columns) and scatters it to
+    position ``start + i`` — gather-before-scatter, so overlapping moves
+    (dst slots are always <= src slots: depth <= column index) read
+    pre-move bytes. Identity entries copy onto themselves; rows past a
+    slot's real width point at whatever the clamp hits, which is either
+    a self-copy or the scratch page — both unobservable. One jitted
+    program serves every step (the engine pads ``src`` with identity).
+
+    Accepted KV bytes are MOVED verbatim (quantized bytes + scales under
+    kv_quant), so the compacted pool is bitwise the pool a sequential
+    decode of the accepted tokens would have produced — the greedy
+    byte-identity argument runs through this function.
+    """
+    B, W = src.shape
+    psz = cache["k"].shape[2]
+    P = page_table.shape[1]
+    max_pos = P * psz - 1
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    src_pos = jnp.minimum(seq_lens[:, None] + src.astype(jnp.int32),
+                          max_pos)
+    dst_pos = jnp.minimum(seq_lens[:, None] + steps, max_pos)
+    layer = jnp.arange(n_layers, dtype=jnp.int32)[:, None, None] * num_pages
+    rows_src = layer + page_table[bidx, src_pos // psz][None]   # [L, B, W]
+    rows_dst = layer + page_table[bidx, dst_pos // psz][None]
+    off_src = jnp.broadcast_to(src_pos % psz, (n_layers, B, W))
+    off_dst = jnp.broadcast_to(dst_pos % psz, (n_layers, B, W))
+    out = dict(cache)
+    for name, arr in cache.items():
+        # Pools are [rows, K, psz, H]; scale pools [rows, K, SCALE_LANES].
+        # Either way the per-token column is axis 2 of the row block.
+        vals = arr[rows_src, :, off_src]
+        out[name] = arr.at[rows_dst, :, off_dst].set(vals)
+    return out
+
+
 def poison_page(cache: Cache, page, *, n_layers: int, num_pages: int) -> Cache:
     """Overwrite one pool page's K rows (all layers) with NaN — the fault
     INJECTION primitive behind the NaN-quarantine tests (runtime/fault.py

@@ -547,6 +547,8 @@ def _verify_ctx(
     W: int,
     max_seq_len: int,
     cfg: ModelConfig,
+    depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
+    tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
 ) -> dict:
     """Batch-level tensors for the verify body (speculative decoding).
 
@@ -560,6 +562,18 @@ def _verify_ctx(
     context limit cannot clobber its own final KV slot the way a clamp
     would; the pallas kernel excludes them from its in-kernel merge
     instead. Both leave every real page untouched.
+
+    Token trees (``depths``/``tree_mask`` given, inference.spec_tree_width
+    > 1): column j still WRITES its KV at pool position ``seq_lens + j``
+    (slot-sequential — page provisioning and the fused write are
+    layout-identical to the chain), but its LOGICAL position (RoPE,
+    causal/window structure) is ``seq_lens + depths[b, j]`` and it
+    attends, among the W new columns, exactly the columns whose bits are
+    set in ``tree_mask[b, j]`` (its ancestors, the root, itself) instead
+    of every earlier column. Chain-shaped inputs (depths == steps, words
+    == the causal prefix bits) produce bit-identical masks to the
+    position-order formulation, so the degenerate tree IS today's
+    verify; with both None this function is untouched (same trace).
     """
     B = seq_lens.shape[0]
     kp = cache["k"]
@@ -568,20 +582,58 @@ def _verify_ctx(
     P = page_table.shape[1]
     batch_idx = jnp.arange(B)[:, None]
     steps = jnp.arange(W, dtype=jnp.int32)[None, :]
-    q_pos = seq_lens[:, None] + steps                       # [B, W] true
-    wp = jnp.minimum(q_pos, max_seq_len - 1)                # in-bounds
+    tree = tree_mask is not None
+    assert (depths is None) == (tree_mask is None)
+    # WRITE positions are always slot-sequential (cursor + column).
+    write_pos = seq_lens[:, None] + steps                   # [B, W] true
+    wp = jnp.minimum(write_pos, max_seq_len - 1)            # in-bounds
     valid = (
-        active[:, None] & (steps < lens[:, None]) & (q_pos < max_seq_len)
+        active[:, None] & (steps < lens[:, None])
+        & (write_pos < max_seq_len)
     )
     page_idx = jnp.where(
         valid, page_table[batch_idx, wp // psz], 0
     )                                                       # [B, W]
     offset = wp % psz
-    # KV positions each query may attend AFTER the row's writes land:
-    # everything at or before the query's own position (earlier drafts in
-    # the same dispatch included — they sit at positions seq_lens..q_pos).
     kv_arange = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
-    kv_base_mask = kv_arange <= q_pos[:, :, None]           # [B, W, P*psz]
+    if not tree:
+        # Chain: logical position == write position; each query attends
+        # everything at or before its own position (earlier drafts of
+        # the same dispatch included — they sit at seq_lens..q_pos).
+        q_pos = write_pos
+        rope_pos = wp
+        kv_base_mask = kv_arange <= q_pos[:, :, None]
+        in_slots = slot_depth = None
+    else:
+        q_pos = seq_lens[:, None] + depths.astype(jnp.int32)
+        rope_pos = jnp.minimum(q_pos, max_seq_len - 1)
+        # Committed context (below the cursor) is visible to every
+        # query; the W new columns are visible by ancestor bit.
+        slot_idx = kv_arange - seq_lens[:, None, None]      # [B, 1, P*psz]
+        in_slots = (slot_idx >= 0) & (slot_idx < W)
+        anc = (
+            jnp.right_shift(
+                tree_mask.astype(jnp.int32)[:, :, None],
+                steps[None, :, :],
+            )
+            & 1
+        ).astype(bool)                                      # [B, W(q), W(kv)]
+        anc = anc | jnp.eye(W, dtype=bool)[None]            # self-visibility
+        slot_c = jnp.clip(slot_idx, 0, W - 1)
+        vis_new = jnp.take_along_axis(
+            anc, jnp.broadcast_to(slot_c, (B, W, P * psz)), axis=2
+        )
+        # Per-kv-position slot depth (for the sliding-window test among
+        # new columns, which windows DEPTH, not pool offset).
+        slot_depth = jnp.take_along_axis(
+            jnp.broadcast_to(
+                depths.astype(jnp.int32)[:, None, :], (B, 1, W)
+            ),
+            slot_c, axis=2,
+        )                                                   # [B, 1, P*psz]
+        kv_base_mask = jnp.where(
+            in_slots, vis_new, kv_arange < seq_lens[:, None, None]
+        )
 
     from orion_tpu.ops._dispatch import resolve_impl
 
@@ -597,10 +649,12 @@ def _verify_ctx(
     k_lens = jnp.clip(jnp.minimum(lens, max_seq_len - start), 1, W)
     return dict(
         B=B, W=W, psz=psz, NP=NP, P=P, quant="k_scale" in cache,
-        page_table=page_table, positions=wp, q_pos=q_pos,
+        page_table=page_table, positions=rope_pos, q_pos=q_pos,
         page_idx=page_idx, offset=offset,
         kv_arange=kv_arange, kv_base_mask=kv_base_mask,
         start=start, k_lens=k_lens,
+        depths=depths, tree_mask=tree_mask,
+        in_slots=in_slots, slot_depth=slot_depth,
         use_pallas=use_pallas, interpret=interpret,
     )
 
@@ -667,6 +721,8 @@ def _verify_layer(
             interpret=ctx["interpret"],
             k_scale=cc.get("k_scale"),
             v_scale=cc.get("v_scale"),
+            tree_mask=ctx["tree_mask"],
+            depths=ctx["depths"],
             mesh=mesh,
         )
         if quant:
@@ -706,9 +762,18 @@ def _verify_layer(
         v_ctx = v_ctx.reshape(B, P * psz, K, H)
         kv_mask = ctx["kv_base_mask"]
         if win is not None:
-            kv_mask = kv_mask & (
+            wmask = (
                 ctx["kv_arange"] >= (ctx["q_pos"] - win + 1)[:, :, None]
             )
+            if ctx["tree_mask"] is not None:
+                # Among the W new columns the window measures DEPTH
+                # distance (logical positions), not pool-slot distance —
+                # chain-degenerate trees make the two identical.
+                dmask = ctx["slot_depth"] >= (
+                    ctx["depths"].astype(jnp.int32) - win + 1
+                )[:, :, None]
+                wmask = jnp.where(ctx["in_slots"], dmask, wmask)
+            kv_mask = kv_mask & wmask
         out = attention_xla(
             q, k_ctx, v_ctx, causal=False, mask=kv_mask,
             logit_softcap=cfg.attn_logit_softcap,
@@ -752,6 +817,9 @@ def verify_step(
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
     nan_guard: bool = False,
+    depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
+    parents: Optional[jax.Array] = None,    # [B, W] parent column per col
+    tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
 ) -> tuple[jax.Array, ...]:
     """Score K drafts for EVERY live slot in ONE dispatch (speculative
     decoding's verification half; drafting is infer/spec_decode.py).
@@ -781,12 +849,23 @@ def verify_step(
     scatter + masked gather with a W dimension, kept as the reference.
     Either way the per-position logits match sequential decode on the
     same kernel setting bit-for-bit.
+
+    Token trees (``depths``/``parents``/``tree_mask`` given): columns
+    1..lens-1 hold a flattened DraftTree instead of a chain — writes
+    stay slot-sequential, attention follows the ancestor mask, and
+    acceptance becomes the CHILD-indexed tree walk of
+    ``sampling.spec_verify_sample_tree``. With all three None this is
+    bit-for-bit the chain program.
     """
-    from orion_tpu.infer.sampling import spec_verify_sample
+    from orion_tpu.infer.sampling import (
+        spec_verify_sample,
+        spec_verify_sample_tree,
+    )
 
     W = tokens.shape[1]
     ctx = _verify_ctx(
-        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg
+        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg,
+        depths=depths, tree_mask=tree_mask,
     )
 
     def body(carry, bp, l, j):
@@ -796,10 +875,16 @@ def verify_step(
     x = embed(params, tokens, ctx["positions"], cfg)
     x, cache = _scan_layers(params, cfg, body, (x, dict(cache)))
     logits = unembed(params, x, cfg)                       # [B, W, V]
-    accept, alt = spec_verify_sample(
-        logits, _draft_next(tokens, lens), key,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-    )
+    if parents is None:
+        accept, alt = spec_verify_sample(
+            logits, _draft_next(tokens, lens), key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+    else:
+        accept, alt = spec_verify_sample_tree(
+            logits, tokens, parents, lens, key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
     if nan_guard:
         # Per-slot finite check over the row's REAL positions only (padding
         # positions compute on scratch-page garbage by design).
@@ -916,6 +1001,9 @@ def mixed_verify_step(
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
     nan_guard: bool = False,
+    depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
+    parents: Optional[jax.Array] = None,    # [B, W] parent column per col
+    tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
 ) -> tuple[jax.Array, ...]:
     """``mixed_step`` with the decode half replaced by the verify body:
     speculative decoding composed with chunked prefill. One dispatch runs
@@ -931,7 +1019,10 @@ def mixed_verify_step(
     decoding (its pages are not in any chunk row), so the in-place pool
     updates commute.
     """
-    from orion_tpu.infer.sampling import spec_verify_sample
+    from orion_tpu.infer.sampling import (
+        spec_verify_sample,
+        spec_verify_sample_tree,
+    )
 
     W = tokens.shape[1]
     pctx = _prefill_ctx(
@@ -939,7 +1030,8 @@ def mixed_verify_step(
         cfg,
     )
     vctx = _verify_ctx(
-        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg
+        cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg,
+        depths=depths, tree_mask=tree_mask,
     )
 
     def body(carry, bp, l, j):
@@ -952,10 +1044,16 @@ def mixed_verify_step(
     xv = embed(params, tokens, vctx["positions"], cfg)
     xp, xv, cache = _scan_layers(params, cfg, body, (xp, xv, dict(cache)))
     logits = unembed(params, xv, cfg)                      # [B, W, V]
-    accept, alt = spec_verify_sample(
-        logits, _draft_next(tokens, lens), key,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-    )
+    if parents is None:
+        accept, alt = spec_verify_sample(
+            logits, _draft_next(tokens, lens), key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+    else:
+        accept, alt = spec_verify_sample_tree(
+            logits, tokens, parents, lens, key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
     p_logits = _prefill_logits(params, xp, p_lengths, cfg)
     if nan_guard:
         steps = jnp.arange(W, dtype=jnp.int32)[None, :]

@@ -184,3 +184,107 @@ def spec_verify_sample(
     accept = jnp.where(temp > 0, u < p_draft, g == dn) & (dn >= 0)
     alt = jnp.where(temp > 0, alt_s, g)
     return accept.reshape(B, W), alt.reshape(B, W)
+
+
+def spec_verify_sample_tree(
+    logits: jax.Array,       # [B, W, V] verify logits, column-major
+    tokens: jax.Array,       # [B, W] i32: col 0 the pending token, cols
+    #                          1..lens-1 the tree nodes' tokens
+    parents: jax.Array,      # [B, W] i32: parent COLUMN per column (col 0
+    #                          ignored); chain rows carry j - 1
+    lens: jax.Array,         # [B] i32: real columns (1..W)
+    key: jax.Array,
+    *,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Token-tree draft acceptance (``spec_verify_sample`` generalized
+    from a chain to an ancestor tree; SpecInfer-style multi-branch
+    rejection sampling).
+
+    Returns ``(accept [B, W] bool, alt [B, W] int32)``, CHILD-indexed:
+    ``accept[c]`` says whether node column c is accepted by its PARENT's
+    logits, and ``alt[j]`` is column j's fallback token — drawn from j's
+    filtered target distribution with j's own children's tokens excluded
+    (the residual after every child was rejected; a leaf excludes
+    nothing, which is the chain bonus sample). The host walks the tree
+    root-down: at each node it descends into the first accepted child in
+    sibling (insertion-priority) order, else emits ``alt`` and stops.
+
+    Greedy (temperature <= 0): ``accept[c]`` is an exact argmax match
+    against the parent — at most one sibling can match (sibling tokens
+    are distinct by tree construction), so the walk reproduces
+    sequential greedy decoding byte-for-byte, and a chain-shaped tree
+    reproduces ``spec_verify_sample``'s emissions exactly.
+
+    Sampled rows: sibling c's acceptance probability is
+    ``p(x_c) / (1 - sum of ELDER siblings' p)`` — the sequential
+    rejection-sampling scheme against the shared filtered target
+    (filter_logits): try the first sibling against p, on rejection
+    renormalize p without it and try the next, finally sample the
+    residual excluding all siblings. The marginal law of every emitted
+    token is exactly p, so the served distribution is unchanged; with a
+    single child per node this is rejection sampling against the same
+    target as ``spec_verify_sample`` (the draws ride child-indexed keys,
+    so the chain STREAM differs while the law does not).
+    """
+    B, W, V = logits.shape
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    valid = (steps >= 1) & (steps < lens[:, None])             # [B, W]
+    par = jnp.clip(parents.astype(jnp.int32), 0, W - 1)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [B, W]
+    g_par = jnp.take_along_axis(greedy, par, axis=1)           # [B, W]
+    g_accept = valid & (g_par == tokens)
+    if isinstance(temperature, (int, float)) and temperature <= 0.0:
+        return g_accept, greedy
+
+    flat = logits.reshape(B * W, V).astype(jnp.float32)
+    rep = lambda a, dt: jnp.broadcast_to(  # noqa: E731
+        jnp.asarray(a, dt).reshape(-1, 1) if jnp.ndim(a) else
+        jnp.asarray(a, dt), (B, W)
+    ).reshape(B * W)
+    temp = rep(temperature, jnp.float32)
+    no_topk = isinstance(top_k, int) and top_k == 0
+    no_topp = isinstance(top_p, (int, float)) and top_p >= 1.0
+    filtered = filter_logits(
+        flat, temp, rep(top_k, jnp.int32), rep(top_p, jnp.float32),
+        no_topk=no_topk, no_topp=no_topp,
+    ).reshape(B, W, V)
+    probs = jax.nn.softmax(filtered, axis=-1)                  # [B, W, V]
+    # p(x_c) under the PARENT's target distribution, per child column.
+    parent_probs = probs[jnp.arange(B)[:, None], par]          # [B, W, V]
+    p_vals = jnp.take_along_axis(
+        parent_probs, jnp.clip(tokens, 0, V - 1)[:, :, None], axis=2
+    )[:, :, 0]
+    p_vals = jnp.where(valid, p_vals, 0.0)                     # [B, W]
+    # Elder-sibling mass: same parent, earlier column — the probability
+    # already consumed by the siblings tried (and rejected) before c.
+    same_par = par[:, :, None] == par[:, None, :]              # [B, W, W]
+    elder = (
+        same_par & (steps[:, None, :] < steps[:, :, None])
+        & valid[:, :, None] & valid[:, None, :]
+    )
+    mass = jnp.einsum("bcs,bs->bc", elder.astype(jnp.float32), p_vals)
+    k_u, k_alt = jax.random.split(key)
+    u = jax.random.uniform(k_u, (B, W))
+    s_accept = valid & (
+        u * jnp.maximum(1.0 - mass, 1e-9) < p_vals
+    )
+    # Residual fallback per NODE: its target with its children's tokens
+    # excluded (scatter child tokens onto their parents' rows; invalid
+    # columns drop out of range).
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, W))
+    excl = jnp.zeros((B, W, V), bool).at[
+        bidx,
+        jnp.where(valid, par, W),
+        jnp.clip(tokens, 0, V - 1),
+    ].set(True, mode="drop")
+    alt_s = jax.random.categorical(
+        k_alt, jnp.where(excl, NEG_INF, filtered).reshape(B * W, V),
+        axis=-1,
+    ).astype(jnp.int32).reshape(B, W)
+    tmat = temp.reshape(B, W)
+    accept = jnp.where(tmat > 0, s_accept, g_accept)
+    alt = jnp.where(tmat > 0, alt_s, greedy)
+    return accept, alt

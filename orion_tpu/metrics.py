@@ -134,6 +134,17 @@ class SpecDecodeStats:
     verify_steps: int = 0
     verify_slot_steps: int = 0
     gated_steps: int = 0
+    # Token-tree speculation (inference.spec_tree_width > 1):
+    # ``tree_nodes`` counts drafted tree nodes (a subset of ``drafted``),
+    # ``tree_branch_nodes`` the nodes OUTSIDE the primary chain (the
+    # extra breadth a single-path draft could not carry),
+    # ``compactions``/``compacted_tokens`` the KV-compaction dispatches
+    # and moved tokens when an accepted path was not the primary chain
+    # (zero on chain-shaped traffic — the layout is already contiguous).
+    tree_nodes: int = 0
+    tree_branch_nodes: int = 0
+    compactions: int = 0
+    compacted_tokens: int = 0
     # Why the engine auto-disabled speculation (degradation ladder: repeated
     # verify-path dispatch faults), or None while speculation is live.
     # Carried across reset_timing drains — disablement is engine-lifetime
@@ -162,6 +173,10 @@ class SpecDecodeStats:
             "verify_slot_steps": self.verify_slot_steps,
             "spec_tokens_per_verify": self.tokens_per_verify,
             "spec_gated_steps": self.gated_steps,
+            "spec_tree_nodes": self.tree_nodes,
+            "spec_tree_branch_nodes": self.tree_branch_nodes,
+            "spec_compactions": self.compactions,
+            "spec_compacted_tokens": self.compacted_tokens,
             "spec_disabled_reason": self.disabled_reason or "",
         }
 

@@ -146,6 +146,7 @@ def _kernel(
     fused_write: bool,
     window: Optional[int],
     quant: bool,
+    tree: bool,
     pt_ref,        # [B, P] scalar-prefetched page table (per-layer-relative)
     base_ref,      # [1] scalar-prefetched flat-pool row base (layer * NP)
     st_ref,        # [B] scalar-prefetched cursor (first new position)
@@ -153,6 +154,12 @@ def _kernel(
     *refs,
 ):
     refs = list(refs)
+    tm_ref = dp_ref = None
+    if tree:
+        # Token-tree verification: packed per-column ancestor words and
+        # tree depths ride the scalar prefetch like the page table.
+        tm_ref, dp_ref = refs[0], refs[1]   # [B, W] i32 each
+        refs = refs[2:]
     q_ref, k_ref, v_ref = refs[:3]
     i = 3
     ks_ref = vs_ref = kn_ref = vn_ref = None
@@ -302,10 +309,43 @@ def _kernel(
         # Row r of a K-band holds query w = r // G (padding rows past
         # W*G clamp to the last query; their outputs are sliced away).
         rowq = lax.broadcasted_iota(jnp.int32, (K * WG8, psz), 0) % WG8
-        q_pos = start + jnp.minimum(rowq // G, W - 1)
-        mask = kv_pos <= q_pos
-        if window is not None:
-            mask &= kv_pos >= q_pos - window + 1
+        qw = jnp.minimum(rowq // G, W - 1)
+        if not tree:
+            q_pos = start + qw
+            mask = kv_pos <= q_pos
+            if window is not None:
+                mask &= kv_pos >= q_pos - window + 1
+        else:
+            # Token tree: committed context (kv_pos < start) is visible
+            # to every query; among the W new slots, query w sees slot i
+            # iff bit i of its ancestor word is set (or i == w). Depths
+            # replace slot order for logical positions: W static and
+            # small, so the per-row word/depth vectors build as W
+            # unrolled scalar-SMEM selects (Mosaic has no vector gather
+            # from SMEM), noise next to the dot_generals.
+            word = jnp.zeros_like(qw)
+            qdep = jnp.zeros_like(qw)
+            for w in range(W):
+                word = jnp.where(qw == w, tm_ref[b, w], word)
+                qdep = jnp.where(qw == w, dp_ref[b, w], qdep)
+            slot = kv_pos - start
+            in_new = (slot >= 0) & (slot < W)
+            bit = (
+                lax.shift_right_logical(word, jnp.clip(slot, 0, 31)) & 1
+            ) == 1
+            mask = jnp.where(in_new, bit | (slot == qw), kv_pos < start)
+            if window is not None:
+                # Window distance among new slots is DEPTH distance
+                # (two siblings at one depth are window-equivalent even
+                # though their pool slots differ).
+                sdep = jnp.zeros_like(slot)
+                for w in range(W):
+                    sdep = jnp.where(slot == w, dp_ref[b, w], sdep)
+                mask &= jnp.where(
+                    in_new,
+                    sdep >= qdep - window + 1,
+                    kv_pos >= start + qdep - window + 1,
+                )
         z = jnp.where(mask, z, NEG_INF)
 
         m_prev = m_s[:, :1]
@@ -333,7 +373,8 @@ def _kernel(
 
 
 def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
-          softcap, window, interpret, k_scale=None, v_scale=None):
+          softcap, window, interpret, k_scale=None, v_scale=None,
+          tree_mask=None, depths=None):
     B, W, N, H = q.shape
     rows_total, K, psz, _ = k_pool.shape
     P = page_table.shape[1]
@@ -343,6 +384,7 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
     W8 = max(round_up(W, 8), 8)
     fused_write = k_new is not None
     quant = k_scale is not None
+    tree = tree_mask is not None
 
     # Pack the W queries' GQA bands per kv head: [K, W*G] rows, padded to
     # a sublane multiple — the kernel recovers (w, g) from the row index.
@@ -352,11 +394,13 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, WG8 - WG), (0, 0)))
     qg = qg.reshape(B, K * WG8, H)
 
-    def kv_index(b, ip, pt, bs, st, ln):
+    def kv_index(b, ip, pt, bs, st, ln, *_):
         # Same clamp discipline as the W=1 kernel's (see its kv_index):
         # tail pages clamp DOWN to the row's last valid page, behind-
         # window pages clamp UP to the window's first — both elide the
-        # DMA and keep revisit write-backs self-consistent.
+        # DMA and keep revisit write-backs self-consistent. (*_ absorbs
+        # the tree-mode scalar-prefetch operands; the page walk is
+        # tree-agnostic — slots stay cursor-sequential.)
         last = jnp.minimum(st[b] + ln[b] - 1, P * psz - 1)
         valid_ip = jnp.minimum(ip, last // psz)
         if window is not None:
@@ -364,7 +408,7 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
             valid_ip = jnp.maximum(valid_ip, jnp.minimum(first, last // psz))
         return (bs[0] + pt[b, valid_ip], 0, 0, 0)
 
-    def row_index(b, ip, pt, bs, st, ln):
+    def row_index(b, ip, pt, bs, st, ln, *_):
         return (b, 0, 0)
 
     q_spec = pl.BlockSpec((1, K * WG8, H), row_index)
@@ -374,7 +418,7 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
     if quant:
         sw = k_scale.shape[-1]
         sc_spec = pl.BlockSpec(
-            (1, K, sw), lambda b, ip, pt, bs, st, ln: kv_index(
+            (1, K, sw), lambda b, ip, pt, bs, st, ln, *_: kv_index(
                 b, ip, pt, bs, st, ln)[:3]
         )
         in_specs += [sc_spec, sc_spec]
@@ -391,7 +435,7 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
             kn = jnp.pad(kn, ((0, 0), (0, 0), (0, W8 - W), (0, 0)))
             vn = jnp.pad(vn, ((0, 0), (0, 0), (0, W8 - W), (0, 0)))
         new_spec = pl.BlockSpec(
-            (1, K, W8, H), lambda b, ip, pt, bs, st, ln: (b, 0, 0, 0)
+            (1, K, W8, H), lambda b, ip, pt, bs, st, ln, *_: (b, 0, 0, 0)
         )
         in_specs += [new_spec, new_spec]
         args += [kn, vn]
@@ -401,21 +445,32 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
             jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
         ]
         # Operand indices count the scalar-prefetch args (pt, base, st,
-        # ln) and q before the pools; without quant the pools are
-        # operands 5 and 6 -> outputs 1 and 2. With quant the scale pools
-        # sit between the data pools and k_new/v_new, aliased alongside.
+        # ln, + tree words/depths in tree mode) and q before the pools;
+        # without quant the pools are the next two operands after q ->
+        # outputs 1 and 2. With quant the scale pools sit between the
+        # data pools and k_new/v_new, aliased alongside.
+        n_prefetch = 6 if tree else 4
+        base_op = n_prefetch + 1            # q sits right after prefetch
         if quant:
             out_specs += [sc_spec, sc_spec]
             out_shape += [
                 jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
                 jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
             ]
-            aliases = {5: 1, 6: 2, 7: 3, 8: 4}
+            aliases = {base_op + i: 1 + i for i in range(4)}
         else:
-            aliases = {5: 1, 6: 2}
+            aliases = {base_op: 1, base_op + 1: 2}
 
+    prefetch = [
+        page_table.astype(jnp.int32), base, start.astype(jnp.int32),
+        lens.astype(jnp.int32),
+    ]
+    if tree:
+        prefetch += [
+            tree_mask.astype(jnp.int32), depths.astype(jnp.int32)
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=len(prefetch),
         grid=(B, P),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -428,16 +483,13 @@ def _call(q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
     out = pl.pallas_call(
         functools.partial(
             _kernel, softcap, psz, K, G, W, WG8, W8, fused_write, window,
-            quant,
+            quant, tree,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=resolve_interpret(interpret),
-    )(
-        page_table.astype(jnp.int32), base, start.astype(jnp.int32),
-        lens.astype(jnp.int32), *args,
-    )
+    )(*prefetch, *args)
     attn = out[0].reshape(B, K, WG8, H)[:, :, :WG, :]
     attn = attn.reshape(B, K, W, G, H).transpose(0, 2, 1, 3, 4)
     attn = attn.reshape(B, W, N, H)
@@ -465,6 +517,12 @@ def ragged_paged_attention(
     interpret: Optional[bool] = None,
     k_scale: Optional[jax.Array] = None,    # [rows, K, SCALE_LANES] f32:
     v_scale: Optional[jax.Array] = None,    #   int8-pool per-token scales
+    tree_mask: Optional[jax.Array] = None,  # [B, W] i32 packed ancestor
+    #                                         words (bit i of word j: query
+    #                                         j may attend new slot i)
+    depths: Optional[jax.Array] = None,     # [B, W] i32 tree depth per
+    #                                         column (logical position =
+    #                                         start + depth)
     mesh: Optional[jax.sharding.Mesh] = None,
     tp_axis: str = "tp",
 ):
@@ -484,11 +542,29 @@ def ragged_paged_attention(
     ``k_scale``/``v_scale`` the pools are int8 (inference.kv_quant) and
     the fused write quantizes in-kernel (kv_cache.quantize_kv semantics),
     returning ``(out, k_pool', v_pool', k_scale', v_scale')``.
+
+    Token trees (``tree_mask``/``depths``): the intra-dispatch causal
+    mask generalizes to an arbitrary ancestor mask — query j attends the
+    committed context plus exactly the new slots whose bits are set in
+    its packed word (ancestors/root/self), at logical position
+    ``start + depths[b, j]``; KV WRITES stay slot-sequential
+    (``start + j``), so the page walk, fused write and provisioning are
+    unchanged. Chain-shaped words/depths reproduce the positional mask
+    bit-for-bit (the degenerate case IS the plain W-query verify). Mask
+    words are int32, so tree verification caps W at 31 columns.
     """
     assert (k_new is None) == (v_new is None)
     assert (k_scale is None) == (v_scale is None)
+    if (tree_mask is None) != (depths is None):
+        raise ValueError("tree_mask and depths must be given together")
     if window is not None and window < 1:
         raise ValueError(f"window={window} must be >= 1")
+    if tree_mask is not None and q.shape[1] > 31:
+        raise ValueError(
+            f"tree verification packs the ancestor mask into int32 words: "
+            f"W={q.shape[1]} columns exceed the 31-bit budget; lower "
+            f"inference.speculate_tokens"
+        )
     K = k_pool.shape[1]
     assert q.shape[2] % K == 0, (q.shape, K)
     base = jnp.asarray(layer_base, jnp.int32).reshape(1)
@@ -524,18 +600,27 @@ def ragged_paged_attention(
             in_specs += [scspec, scspec]
             if have_new:
                 out_specs += [scspec, scspec]
+        have_tree = tree_mask is not None
+        if have_tree:
+            # Ancestor words/depths are head-independent: replicated,
+            # like the page table.
+            args += [tree_mask, depths]
+            in_specs += [rep2, rep2]
 
         def body(q_, kp_, vp_, pt_, st_, ln_, base_, *rest):
-            kn = vn = ks = vs = None
+            kn = vn = ks = vs = tm = dp = None
             rest = list(rest)
             if have_new:
                 kn, vn = rest[0], rest[1]
                 rest = rest[2:]
             if have_scale:
                 ks, vs = rest[0], rest[1]
+                rest = rest[2:]
+            if have_tree:
+                tm, dp = rest[0], rest[1]
             res = _call(
                 q_, kp_, vp_, pt_, st_, ln_, base_, kn, vn,
-                logit_softcap, window, interpret, ks, vs,
+                logit_softcap, window, interpret, ks, vs, tm, dp,
             )
             if not have_new:
                 return res[0]
@@ -554,6 +639,7 @@ def ragged_paged_attention(
     out = _call(
         q, k_pool, v_pool, page_table, start, lens, base, k_new, v_new,
         logit_softcap, window, interpret, k_scale, v_scale,
+        tree_mask, depths,
     )
     if k_new is None:
         return out[0]

@@ -937,3 +937,156 @@ def test_ragged_paged_attention_rejects_degenerate_window():
             jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
             window=0, interpret=True,
         )
+
+
+# -- token-tree ancestor masks (tree speculation, ISSUE 11) ------------------
+
+
+def _chain_tree_arrays(B, W):
+    """Chain-shaped [B, W] depth / packed-ancestor-word arrays — the
+    degenerate tree whose mask must be bitwise the positional mask."""
+    steps = np.arange(W, dtype=np.int64)
+    depths = np.tile(steps.astype(np.int32), (B, 1))
+    words = np.tile(
+        ((np.int64(1) << (steps + 1)) - 1).astype(np.int32), (B, 1)
+    )
+    return jnp.asarray(depths), jnp.asarray(words)
+
+
+def _tree_arrays(B, W, parents):
+    """[B, W] depth/word arrays for one tree shape shared by all rows.
+    ``parents`` is the parent COLUMN per node column 1..n (DraftTree
+    layout); columns past the tree stay chain-shaped padding."""
+    from orion_tpu.infer.spec_decode import DraftTree
+
+    t = DraftTree(tokens=[0] * len(parents), parents=list(parents))
+    depths, words = _chain_tree_arrays(B, W)
+    n = len(parents) + 1
+    depths = depths.at[:, :n].set(jnp.asarray(t.depths(), jnp.int32))
+    words = words.at[:, :n].set(jnp.asarray(t.mask_words(), jnp.int32))
+    return depths, words
+
+
+def _tree_reference(q, k_pool, v_pool, page_table, start, lens,
+                    k_new, v_new, depths, words, window=None):
+    """The verify body's xla semantics under an ancestor mask: writes
+    stay slot-sequential (identical to _ragged_reference's scatter), the
+    committed context is visible to every query, and among the W new
+    slots query c sees slot i iff bit i of its word is set (or i == c);
+    sliding windows measure DEPTH distance among the new slots."""
+    from orion_tpu.ops.attention import attention_xla
+
+    B, W, N, H = q.shape
+    K, psz = k_pool.shape[1], k_pool.shape[2]
+    P = page_table.shape[1]
+    npg = k_pool.shape[0]
+    steps = jnp.arange(W, dtype=jnp.int32)[None, :]
+    wpos = start[:, None] + steps                          # write slots
+    valid = steps < lens[:, None]
+    kp = jnp.concatenate(
+        [k_pool, jnp.zeros((1,) + k_pool.shape[1:], k_pool.dtype)])
+    vp = jnp.concatenate(
+        [v_pool, jnp.zeros((1,) + v_pool.shape[1:], v_pool.dtype)])
+    rows = jnp.where(
+        valid, page_table[jnp.arange(B)[:, None], wpos // psz], npg
+    )
+    off = wpos % psz
+    kp = kp.at[rows, :, off].set(k_new)[:npg]
+    vp = vp.at[rows, :, off].set(v_new)[:npg]
+    k_ctx = kp[page_table].transpose(0, 1, 3, 2, 4).reshape(B, P * psz, K, H)
+    v_ctx = vp[page_table].transpose(0, 1, 3, 2, 4).reshape(B, P * psz, K, H)
+    kv = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+    slot = kv - start[:, None, None]                       # [B, 1, P*psz]
+    in_new = (slot >= 0) & (slot < W)
+    slot_c = jnp.clip(slot, 0, W - 1)
+    anc = ((words[:, :, None] >> steps[None, :, :]) & 1).astype(bool)
+    anc = anc | jnp.eye(W, dtype=bool)[None]
+    vis = jnp.take_along_axis(
+        anc, jnp.broadcast_to(slot_c, (B, W, P * psz)), axis=2
+    )
+    mask = jnp.where(in_new, vis, kv < start[:, None, None])
+    if window is not None:
+        sdep = jnp.take_along_axis(
+            jnp.broadcast_to(depths[:, None, :], (B, 1, W)), slot_c, axis=2
+        )
+        qdep = depths[:, :, None]
+        mask &= jnp.where(
+            in_new, sdep >= qdep - window + 1,
+            kv >= start[:, None, None] + qdep - window + 1,
+        )
+    out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=mask)
+    return out, kp, vp
+
+
+def test_ragged_tree_chain_degenerate_bitwise():
+    """Chain-shaped tree words/depths produce BITWISE the plain kernel's
+    outputs and written pools — the degenerate tree IS today's W-query
+    verify (tree machinery adds ops, not numerics)."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case()
+    B, W = q.shape[0], q.shape[1]
+    depths, words = _chain_tree_arrays(B, W)
+    for win in (None, 20):
+        plain = ragged_paged_attention(
+            q, kp, vp, pt, start, lens, k_new=kn, v_new=vn, window=win,
+            interpret=True,
+        )
+        tree = ragged_paged_attention(
+            q, kp, vp, pt, start, lens, k_new=kn, v_new=vn, window=win,
+            tree_mask=words, depths=depths, interpret=True,
+        )
+        for a, b in zip(plain, tree):
+            assert (np.asarray(a) == np.asarray(b)).all(), win
+
+
+def test_ragged_tree_branchy_matches_reference():
+    """A branchy ancestor mask (two sibling branches off the root, one
+    nested branch) against the scatter + ancestor-masked-gather
+    reference: sibling slots must NOT see each other, nested nodes see
+    exactly their path, and the fused write stays slot-sequential."""
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q, kp, vp, kn, vn, pt, start, lens = _ragged_case(key=9)
+    B, W = q.shape[0], q.shape[1]
+    # Columns: 1<-0, 2<-1 (primary chain), 3<-0 (sibling), 4<-3 (nested).
+    depths, words = _tree_arrays(B, W, parents=[0, 1, 0, 3])
+    lens = jnp.asarray([W, 1, 3], jnp.int32)
+    ref, kpr, vpr = _tree_reference(
+        q, kp, vp, pt, start, lens, kn, vn, depths, words)
+    out, kp2, vp2 = ragged_paged_attention(
+        q, kp, vp, pt, start, lens, k_new=kn, v_new=vn,
+        tree_mask=words, depths=depths, interpret=True,
+    )
+    _assert_real_rows_close(out, ref, lens)
+    assert (np.asarray(kp2) == np.asarray(kpr)).all()
+    assert (np.asarray(vp2) == np.asarray(vpr)).all()
+
+    # Sliding window over the tree: depth distance, not slot distance.
+    ref_w, _, _ = _tree_reference(
+        q, kp, vp, pt, start, lens, kn, vn, depths, words, window=2)
+    out_w, _, _ = ragged_paged_attention(
+        q, kp, vp, pt, start, lens, k_new=kn, v_new=vn,
+        tree_mask=words, depths=depths, window=2, interpret=True,
+    )
+    _assert_real_rows_close(out_w, ref_w, lens)
+
+
+def test_ragged_tree_width_limit():
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    q = jnp.zeros((1, 32, 4, 64))
+    pool = jnp.zeros((8, 2, 16, 64))
+    with pytest.raises(ValueError, match="31"):
+        ragged_paged_attention(
+            q, pool, pool, jnp.zeros((1, 32), jnp.int32),
+            jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
+            tree_mask=jnp.zeros((1, 32), jnp.int32),
+            depths=jnp.zeros((1, 32), jnp.int32), interpret=True,
+        )

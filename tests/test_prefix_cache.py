@@ -103,6 +103,79 @@ def test_radix_lru_page_granular_eviction():
 # -- engine equivalence ------------------------------------------------------
 
 
+def test_token_paths_memo_invalidation():
+    """The token_paths() memo (the tree-speculation draft source) must
+    go stale on EVERY path-mutating event: fresh insert, leaf-extending
+    insert (the reap-donation shape), page-granular evict, and clear —
+    while splits and repeated reads keep serving the memo (ISSUE 11
+    audit). A stale memo would feed the proposer ghost paths."""
+    alloc = PageAllocator(64)
+    pc = PrefixCache(4, alloc)
+    toks = list(range(8))
+    pages = alloc.alloc(2)
+    pc.insert(toks, pages)
+    alloc.free(pages)
+    p1 = pc.token_paths()
+    assert p1 == [tuple(toks)]
+    assert pc.token_paths() is p1              # memo hit between mutations
+
+    # Leaf-EXTENDING insert (what a reap donates after a warm hit whose
+    # request generated past its matched prefix): must invalidate.
+    ext = toks + [50, 51, 52, 53]
+    ep = alloc.alloc(3)
+    pc.insert(ext, ep)
+    alloc.free(ep)
+    p2 = pc.token_paths()
+    assert p2 == [tuple(ext)]
+
+    # Diverging insert SPLITS the edge: the path set changes (new leaf)
+    # and the memo refreshes; the split itself adds no ghost paths.
+    br = toks[:4] + [80, 81, 82, 83]
+    bp = alloc.alloc(2)
+    pc.insert(br, bp)
+    alloc.free(bp)
+    assert sorted(pc.token_paths()) == sorted([tuple(ext), tuple(br)])
+
+    # match() may split edges too — the PATH SET is preserved, and the
+    # memo (stale or refreshed) must still serve exactly that set.
+    got, node = pc.match(toks[:4] + [99], max_pages=8)
+    assert sorted(pc.token_paths()) == sorted([tuple(ext), tuple(br)])
+    pc.unlock(node)
+
+    # Page-granular eviction trims a leaf's tail: paths must shrink.
+    assert pc.evict(1) == 1
+    paths = pc.token_paths()
+    assert tuple(ext) not in paths
+    assert any(len(p) == len(ext) - 4 for p in paths) or tuple(br) in paths
+
+    # clear() drops everything.
+    pc.clear()
+    assert pc.token_paths() == []
+
+
+def test_token_paths_reap_donation_visible_to_proposer():
+    """Engine-level regression: the path donated by a finished request
+    (reap -> insert) must be visible to token_paths() IMMEDIATELY — the
+    speculative proposer reads it on the very next step, and PR-3's memo
+    would serve a stale snapshot if the donation path skipped the
+    version bump."""
+    cfg, params = _setup()
+    eng = InferenceEngine(cfg, params)
+    assert eng._pcache.token_paths() == []
+    prompt = list(range(2, 34))               # two full pages + tail
+    eng.generate([prompt], 8)
+    paths = eng._pcache.token_paths()
+    assert paths, "reap donation produced no cached path"
+    psz = eng.psz
+    assert all(len(p) % psz == 0 for p in paths)
+    # The donated path is a prefix of the request's context.
+    ctx = prompt + []
+    assert any(list(p[:len(prompt)]) == prompt[:len(p)] for p in paths)
+    # And a second, different request's donation invalidates again.
+    eng.generate([[201, 202, 203] * 8], 8)
+    assert len(eng._pcache.token_paths()) >= len(paths)
+
+
 def test_prefix_cache_default_off():
     cfg, params = _setup(cache=False)
     assert cfg.inference.prefix_cache is False

@@ -1,21 +1,27 @@
 #!/usr/bin/env python
-"""Speculative decoding on a self-repetitive workload: acceptance rate,
-decode tokens-per-dispatch, ITL percentiles, and the verify KERNEL PATH
-(xla scatter+gather vs the multi-query ragged paged-attention Pallas
-kernel) vs the non-speculative engine (ISSUE 3 'measure', ISSUE 5
-kernel-path column).
+"""Speculative decoding bench: chain vs TREE drafting, looping vs
+non-looping workloads, and the verify KERNEL PATH (xla scatter+gather vs
+the multi-query ragged paged-attention Pallas kernel) vs the
+non-speculative engine (ISSUE 3 'measure', ISSUE 5 kernel-path column,
+ISSUE 11 tree columns).
 
-Scenario: greedy decoding of prompts whose continuations loop (the
-canonical speculative win — code, structured output, models settling into
-a cycle). The prompt-lookup proposer drafts the loop, the verify step
-accepts it, and one weight pass emits several tokens. Each mode runs on
-BOTH kernel settings so the kernel's win is measured, not asserted: one
-JSON line per (mode, verify_path) with ITL percentiles, per-step
-device/host ms (decode_window=1, so a step is one dispatch — for the
-speculative modes that is the per-verify cost), and the speculation
-counters. The final verdict line pins greedy byte-identity per kernel
-path (xla spec-on == xla spec-off; pallas spec-on == pallas spec-off)
-and the device-ms-per-step ratio between verify paths.
+Two workloads, because the two drafting modes win in different regimes:
+
+  - looping: prompts whose greedy continuations cycle — the canonical
+    single-path speculative win (the n-gram proposer drafts the loop).
+    Tree drafting must DEGENERATE here: one candidate, chain-shaped
+    tree, tokens-per-verify-dispatch >= the single-path mode's.
+  - nonloop: low self-repetition prompts with AMBIGUOUS n-gram
+    continuations (the same suffix recurs with different followers) —
+    single-path drafting must bet on the most recent match and stalls;
+    tree drafting carries the alternatives as verified branches, which
+    is where the acceptance uplift is measured (not asserted in prose).
+
+Each speculative mode runs on BOTH kernel settings so the kernel win is
+measured; one JSON line per (workload, mode, verify_path) with ITL
+percentiles, per-step device/host ms, and the speculation counters. The
+final verdict line pins greedy byte-identity per (workload, kernel path,
+mode) and the tree-vs-chain acceptance/throughput columns.
 
     python tools/spec_decode_bench.py          # on-chip numbers
     python tools/spec_decode_bench.py --smoke  # tiny CPU logic check
@@ -24,10 +30,27 @@ and the device-ms-per-step ratio between verify paths.
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import json
+import random
 import sys
 import time
 
 import jax
+
+
+def _ambig_prompts(n, lo, hi, seed0=6, reps=4):
+    """Non-looping prompts with planted ambiguous continuations: the
+    (a, b) bigram recurs with a DIFFERENT follower each time, so the
+    n-gram proposer always has several plausible continuations and a
+    single path must bet on one."""
+    out = []
+    for i in range(n):
+        r = random.Random(seed0 + i)
+        a, b = r.randrange(lo, hi), r.randrange(lo, hi)
+        p = [r.randrange(lo, hi) for _ in range(4)]
+        for _ in range(reps):
+            p += [a, b, r.randrange(lo, hi), r.randrange(lo, hi)]
+        out.append(p + [a, b])
+    return out
 
 
 def _run(eng, prompts, max_new):
@@ -75,10 +98,20 @@ def _run(eng, prompts, max_new):
     for key in ("spec_drafted", "spec_accepted", "spec_rolled_back",
                 "spec_acceptance_rate", "verify_steps",
                 "verify_slot_steps", "spec_tokens_per_verify",
-                "spec_gated_steps"):
+                "spec_gated_steps", "spec_tree_nodes",
+                "spec_tree_branch_nodes", "spec_compactions",
+                "spec_compacted_tokens"):
         if key in t:
             out[key] = round(t[key], 4) if isinstance(t[key], float) \
                 else t[key]
+    if "verify_slot_steps" in t:
+        # Accepted DRAFT tokens per per-slot verify opportunity: the
+        # acceptance column the tree-vs-chain comparison reads (the raw
+        # acceptance_rate divides by drafted NODES, which a tree has
+        # more of by construction).
+        out["accept_per_slot_step"] = round(
+            t["spec_accepted"] / max(t["verify_slot_steps"], 1), 4
+        )
     from orion_tpu.obs import bench_metrics_block
 
     # Standard bench metrics block (ISSUE 9): registry gauges + the
@@ -105,15 +138,16 @@ def main() -> int:
             "inference.num_pages=32", "inference.max_batch_size=4",
             "inference.prefill_chunk=16", "inference.decode_window=1",
         ]
-        speculate, max_new = 4, 40
+        speculate, tree_width, max_new = 4, 3, 40
         # Self-repetitive workload: short cyclic prompts whose greedy
         # continuations loop on the fixed-seed tiny model, so the n-gram
         # proposer has real structure to draft from.
-        prompts = [
+        looping = [
             [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8],
             [5, 6, 5, 6, 5, 6, 5, 6, 5],
             [11, 12, 13, 11, 12, 13, 11, 12, 13, 11, 12],
         ]
+        nonloop = _ambig_prompts(3, 2, 200)
     else:
         preset, base = "llama-1b-bench", [
             "model.param_dtype=bfloat16",
@@ -121,58 +155,110 @@ def main() -> int:
             "inference.num_pages=1024", "inference.max_batch_size=8",
             "inference.prefill_chunk=256", "inference.decode_window=1",
         ]
-        speculate, max_new = 6, 256
-        prompts = [
+        speculate, tree_width, max_new = 6, 4, 256
+        looping = [
             ([17 + i, 91 + i, 203 + i, 44 + i] * 64)[:240]
             for i in range(4)
         ]
+        nonloop = _ambig_prompts(4, 2, 32000, reps=16)
 
-    spec_ov = [
+    chain_ov = [
         "inference.speculative=true",
         f"inference.speculate_tokens={speculate}",
     ]
+    tree_ov = chain_ov + [f"inference.spec_tree_width={tree_width}"]
     # Both kernel settings: "pallas" resolves to the compiled Mosaic
     # kernels on a TPU backend and the Pallas interpreter elsewhere, so
     # the same mode grid serves --smoke and on-chip runs. Greedy streams
     # are comparable only WITHIN a kernel path (the xla and pallas
-    # attention algorithms round differently), so each spec mode gets its
-    # own baseline.
+    # attention algorithms round differently), so each spec mode gets
+    # its own baseline. The nonloop workload reuses the SAME engines
+    # (same programs — only the requests change).
     modes = []
     for path in ("xla", "pallas"):
         kern = [f"model.kernels={path}"]
         modes.append((f"baseline_{path}", path,
                       get_config(preset, base + kern)))
         modes.append((f"speculative_{path}", path,
-                      get_config(preset, base + kern + spec_ov)))
+                      get_config(preset, base + kern + chain_ov)))
+        modes.append((f"tree_{path}", path,
+                      get_config(preset, base + kern + tree_ov)))
     params = init_params(modes[0][2].model, jax.random.key(0))
 
+    workloads = [("looping", looping), ("nonloop", nonloop)]
     results, tokens = {}, {}
     for mode, path, cfg in modes:
         eng = InferenceEngine(cfg, params)
-        _run(eng, prompts, max_new)          # compile pass, same shapes
-        r, toks = _run(eng, prompts, max_new)
-        r["mode"] = mode
-        r["verify_path"] = path
-        r["speculate_tokens"] = (
-            speculate if mode.startswith("speculative") else None
-        )
-        results[mode], tokens[mode] = r, toks
-        print(json.dumps(r))
-    spec_x, spec_p = results["speculative_xla"], results["speculative_pallas"]
-    base_x = results["baseline_xla"]
+        for wname, prompts in workloads:
+            if wname == "nonloop" and path == "pallas":
+                # The nonloop tree-vs-chain comparison is a DRAFTING
+                # property; one kernel path measures it (the pallas
+                # identity is pinned on the looping workload).
+                continue
+            _run(eng, prompts, max_new)      # compile pass, same shapes
+            r, toks = _run(eng, prompts, max_new)
+            r["mode"] = mode
+            r["workload"] = wname
+            r["verify_path"] = path
+            r["speculate_tokens"] = (
+                None if mode.startswith("baseline") else speculate
+            )
+            r["spec_tree_width"] = (
+                tree_width if mode.startswith("tree") else
+                (1 if mode.startswith("speculative") else None)
+            )
+            results[(wname, mode)] = r
+            tokens[(wname, mode)] = toks
+            print(json.dumps(r))
+
+    lp = {m: results[("looping", m)] for m, _, _ in modes}
+    spec_x, spec_p = lp["speculative_xla"], lp["speculative_pallas"]
+    tree_x, tree_p = lp["tree_xla"], lp["tree_pallas"]
+    base_x = lp["baseline_xla"]
+    nl_chain = results[("nonloop", "speculative_xla")]
+    nl_tree = results[("nonloop", "tree_xla")]
     verdict = {
         # Greedy speculative output must be byte-identical to the
-        # non-speculative engine's (exact argmax acceptance), on each
-        # kernel path — the pallas entry is the ragged-kernel acceptance
-        # criterion of ISSUE 5.
-        "greedy_identical": tokens["baseline_xla"]
-        == tokens["speculative_xla"],
-        "pallas_greedy_identical": tokens["baseline_pallas"]
-        == tokens["speculative_pallas"],
+        # non-speculative engine's (exact argmax acceptance), per kernel
+        # path and per drafting mode — the tree entries are the ISSUE 11
+        # acceptance criterion, the pallas ones ISSUE 5's.
+        "greedy_identical": tokens[("looping", "baseline_xla")]
+        == tokens[("looping", "speculative_xla")],
+        "pallas_greedy_identical": tokens[("looping", "baseline_pallas")]
+        == tokens[("looping", "speculative_pallas")],
+        "tree_greedy_identical": tokens[("looping", "baseline_xla")]
+        == tokens[("looping", "tree_xla")],
+        "tree_pallas_greedy_identical":
+        tokens[("looping", "baseline_pallas")]
+        == tokens[("looping", "tree_pallas")],
+        "nonloop_tree_greedy_identical":
+        tokens[("nonloop", "baseline_xla")]
+        == tokens[("nonloop", "tree_xla")],
         # The amortization the speculation bought: emitted decode tokens
-        # per per-slot verify dispatch (1.0 = speculation bought nothing).
+        # per per-slot verify dispatch (1.0 = speculation bought
+        # nothing). On the LOOPING workload the tree must not lose to
+        # the chain (it degenerates to it).
         "spec_tokens_per_verify": spec_x.get("spec_tokens_per_verify", 0.0),
+        "tree_tokens_per_verify": tree_x.get("spec_tokens_per_verify", 0.0),
         "acceptance_rate": spec_x.get("spec_acceptance_rate", 0.0),
+        # The tree-vs-chain columns on the NON-LOOPING workload: accepted
+        # draft tokens per per-slot verify opportunity (the uplift the
+        # ROADMAP names), tokens/dispatch, and ITL.
+        "nonloop_accept_per_slot": {
+            "chain": nl_chain.get("accept_per_slot_step", 0.0),
+            "tree": nl_tree.get("accept_per_slot_step", 0.0),
+        },
+        "nonloop_tree_uplift": round(
+            nl_tree.get("accept_per_slot_step", 0.0)
+            - nl_chain.get("accept_per_slot_step", 0.0), 4
+        ),
+        "nonloop_tokens_per_verify": {
+            "chain": nl_chain.get("spec_tokens_per_verify", 0.0),
+            "tree": nl_tree.get("spec_tokens_per_verify", 0.0),
+        },
+        "nonloop_itl_p50_ms": {
+            "chain": nl_chain["itl_p50_ms"], "tree": nl_tree["itl_p50_ms"],
+        },
         "itl_p50_ratio": round(
             spec_x["itl_p50_ms"] / base_x["itl_p50_ms"], 4
         ) if base_x["itl_p50_ms"] else None,
@@ -182,6 +268,8 @@ def main() -> int:
         # interpreter timings under --smoke are not device costs).
         "verify_dev_ms": {"xla": spec_x["dev_ms_per_step"],
                           "pallas": spec_p["dev_ms_per_step"]},
+        "tree_verify_dev_ms": {"xla": tree_x["dev_ms_per_step"],
+                               "pallas": tree_p["dev_ms_per_step"]},
         "pallas_dev_ratio": round(
             spec_p["dev_ms_per_step"] / spec_x["dev_ms_per_step"], 4
         ) if spec_x["dev_ms_per_step"] else None,

@@ -16,6 +16,10 @@ compares fwd + grads against the xla reference ops at bench-like shapes:
     multi-token write with BITWISE pool/scale checks vs the host-side
     quantize — the compiled-Mosaic validation of the verify fast path
     (the pytest suite pins the same cases in interpret mode only)
+  - token-TREE verification masks on the same kernel (ISSUE 11):
+    {branchy, chain-degenerate} x {float, int8} x {full, window} —
+    chain-degenerate BITWISE vs the plain kernel, branchy vs the
+    ancestor-masked reference, written pools bitwise
   - fused RMSNorm, fused RoPE
 
 The pytest suite runs these kernels only through the Pallas interpreter on
@@ -306,6 +310,191 @@ def ragged_paged_checks() -> bool:
     return ok
 
 
+def ragged_tree_checks() -> bool:
+    """Compiled token-TREE verification on the ragged kernel (ISSUE 11):
+    the packed ancestor mask + depth scalar-prefetch path, {branchy,
+    chain-degenerate} x {float, int8} x {full, sliding window}.
+
+    Chain-degenerate trees must be BITWISE the plain kernel (outputs and
+    written pools — the tree machinery adds ops, not numerics); branchy
+    trees check against the ancestor-masked scatter+gather reference
+    (pools bitwise either way: writes are slot-sequential and
+    tree-agnostic)."""
+    import numpy as np
+
+    from orion_tpu.infer.kv_cache import SCALE_LANES, quantize_kv
+    from orion_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    ok = True
+    N, K, B, H, psz, P, num_pages = 8, 4, 4, 128, 64, 4, 64
+    W = 5
+    keys = jax.random.split(jax.random.key(17), 6)
+    q = jax.random.normal(keys[0], (B, W, N, H), jnp.bfloat16)
+    k_pool = jax.random.normal(keys[1], (num_pages, K, psz, H), jnp.bfloat16)
+    v_pool = jax.random.normal(keys[2], (num_pages, K, psz, H), jnp.bfloat16)
+    k_new = jax.random.normal(keys[3], (B, W, K, H), jnp.bfloat16)
+    v_new = jax.random.normal(keys[4], (B, W, K, H), jnp.bfloat16)
+    page_table = jnp.asarray(
+        [[5, 17, 2, 9], [30, 1, 7, 3], [11, 4, 63, 22], [8, 40, 33, 6]],
+        jnp.int32,
+    )
+    start = jnp.asarray([0, 93, 127, P * psz - W], jnp.int32)
+    lens = jnp.asarray([W, 1, 3, W], jnp.int32)
+    steps = np.arange(W, dtype=np.int64)
+    chain_dep = jnp.asarray(np.tile(steps, (B, 1)), jnp.int32)
+    chain_words = jnp.asarray(
+        np.tile((np.int64(1) << (steps + 1)) - 1, (B, 1)), jnp.int32
+    )
+    # Branchy shape shared by all rows: 1<-0, 2<-1 (primary), 3<-0
+    # (sibling), 4<-3 (nested) — DraftTree's flattened layout.
+    parents = [0, 1, 0, 3]
+    dep_row, word_row = [0], [1]
+    for j, p in enumerate(parents):
+        dep_row.append(dep_row[p] + 1)
+        word_row.append(word_row[p] | (1 << (j + 1)))
+    tree_dep = jnp.asarray(np.tile(dep_row, (B, 1)), jnp.int32)
+    tree_words = jnp.asarray(np.tile(word_row, (B, 1)), jnp.int32)
+
+    def tree_reference(q, kp, vp, kn, vn, depths, words, window=None):
+        steps_j = jnp.arange(W, dtype=jnp.int32)[None, :]
+        wpos = start[:, None] + steps_j
+        valid = steps_j < lens[:, None]
+        kpx = jnp.concatenate(
+            [kp, jnp.zeros((1,) + kp.shape[1:], kp.dtype)])
+        vpx = jnp.concatenate(
+            [vp, jnp.zeros((1,) + vp.shape[1:], vp.dtype)])
+        rows = jnp.where(
+            valid, page_table[jnp.arange(B)[:, None], wpos // psz],
+            num_pages,
+        )
+        off = wpos % psz
+        kpx = kpx.at[rows, :, off].set(kn.astype(kpx.dtype))[:num_pages]
+        vpx = vpx.at[rows, :, off].set(vn.astype(vpx.dtype))[:num_pages]
+        k_ctx = kpx[page_table].transpose(0, 1, 3, 2, 4).reshape(
+            B, P * psz, K, H)
+        v_ctx = vpx[page_table].transpose(0, 1, 3, 2, 4).reshape(
+            B, P * psz, K, H)
+        kv = jnp.arange(P * psz, dtype=jnp.int32)[None, None, :]
+        slot = kv - start[:, None, None]
+        in_new = (slot >= 0) & (slot < W)
+        slot_c = jnp.clip(slot, 0, W - 1)
+        anc = ((words[:, :, None] >> steps_j[None, :, :]) & 1).astype(bool)
+        anc = anc | jnp.eye(W, dtype=bool)[None]
+        vis = jnp.take_along_axis(
+            anc, jnp.broadcast_to(slot_c, (B, W, P * psz)), axis=2)
+        mask = jnp.where(in_new, vis, kv < start[:, None, None])
+        if window is not None:
+            sdep = jnp.take_along_axis(
+                jnp.broadcast_to(depths[:, None, :], (B, 1, W)),
+                slot_c, axis=2)
+            mask &= jnp.where(
+                in_new, sdep >= depths[:, :, None] - window + 1,
+                kv >= start[:, None, None] + depths[:, :, None]
+                - window + 1,
+            )
+        out = attention_xla(q, k_ctx, v_ctx, causal=False, mask=mask)
+        vmask = (steps_j < lens[:, None])[:, :, None, None]
+        return jnp.where(vmask, out.astype(jnp.float32), 0.0), kpx, vpx
+
+    def masked(o):
+        steps_j = jnp.arange(W, dtype=jnp.int32)[None, :]
+        vmask = (steps_j < lens[:, None])[:, :, None, None]
+        return jnp.where(vmask, o.astype(jnp.float32), 0.0)
+
+    # Float pools: chain-degenerate bitwise vs the plain kernel, then the
+    # branchy mask vs the reference — with and without a window.
+    for wname, win in (("", None), (" window", 100)):
+        plain = jax.jit(
+            lambda q, kp, vp, kn, vn, w=win: ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                window=w, interpret=INTERP)
+        )(q, k_pool, v_pool, k_new, v_new)
+        chain = jax.jit(
+            lambda q, kp, vp, kn, vn, w=win: ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                window=w, tree_mask=chain_words, depths=chain_dep,
+                interpret=INTERP)
+        )(q, k_pool, v_pool, k_new, v_new)
+        exact = all(
+            bool((np.asarray(a) == np.asarray(b)).all())
+            for a, b in zip(plain, chain)
+        )
+        status = "OK" if exact else "FAIL"
+        print(f"{status} tree chain-degenerate{wname} bitwise")
+        ok &= exact
+
+        ref_o, kpr, vpr = tree_reference(
+            q, k_pool, v_pool, k_new, v_new, tree_dep, tree_words,
+            window=win)
+        out_t, kp_t, vp_t = jax.jit(
+            lambda q, kp, vp, kn, vn, w=win: ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                window=w, tree_mask=tree_words, depths=tree_dep,
+                interpret=INTERP)
+        )(q, k_pool, v_pool, k_new, v_new)
+        ok &= check(f"tree branchy{wname} fwd", masked(out_t), ref_o, 2e-2)
+        if win is None:
+            ok &= check("tree branchy k_pool", kp_t, kpr, 1e-6)
+            ok &= check("tree branchy v_pool", vp_t, vpr, 1e-6)
+
+    # int8 pools: branchy tree attention vs the dequantized reference +
+    # chain-degenerate bitwise vs the plain int8 kernel (pools ride the
+    # slot-sequential write, already pinned bitwise above/in
+    # ragged_paged_checks).
+    kq, ks = quantize_kv(k_pool.transpose(0, 2, 1, 3))
+    vq, vs = quantize_kv(v_pool.transpose(0, 2, 1, 3))
+    kq, vq = kq.transpose(0, 2, 1, 3), vq.transpose(0, 2, 1, 3)
+    k_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(ks.transpose(0, 2, 1))
+    v_sc = jnp.zeros((num_pages, K, SCALE_LANES), jnp.float32
+                     ).at[:, :, :psz].set(vs.transpose(0, 2, 1))
+    knq, kns = quantize_kv(k_new)
+    vnq, vns = quantize_kv(v_new)
+    kd = kq.astype(jnp.float32) * k_sc[:, :, :psz][..., None]
+    vd = vq.astype(jnp.float32) * v_sc[:, :, :psz][..., None]
+    for wname, win in (("", None), (" window", 100)):
+        plain_q = jax.jit(
+            lambda q, kp, vp, ksc, vsc, kn, vn, w=win:
+            ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                k_scale=ksc, v_scale=vsc, window=w, interpret=INTERP)
+        )(q, kq, vq, k_sc, v_sc, k_new, v_new)
+        chain_q = jax.jit(
+            lambda q, kp, vp, ksc, vsc, kn, vn, w=win:
+            ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                k_scale=ksc, v_scale=vsc, window=w,
+                tree_mask=chain_words, depths=chain_dep,
+                interpret=INTERP)
+        )(q, kq, vq, k_sc, v_sc, k_new, v_new)
+        exact = all(
+            bool((np.asarray(a) == np.asarray(b)).all())
+            for a, b in zip(plain_q, chain_q)
+        )
+        status = "OK" if exact else "FAIL"
+        print(f"{status} tree int8 chain-degenerate{wname} bitwise")
+        ok &= exact
+
+        ref_q, _, _ = tree_reference(
+            q, kd.astype(jnp.bfloat16), vd.astype(jnp.bfloat16),
+            knq.astype(jnp.float32) * kns[..., None],
+            vnq.astype(jnp.float32) * vns[..., None],
+            tree_dep, tree_words, window=win)
+        out_q = jax.jit(
+            lambda q, kp, vp, ksc, vsc, kn, vn, w=win:
+            ragged_paged_attention(
+                q, kp, vp, page_table, start, lens, k_new=kn, v_new=vn,
+                k_scale=ksc, v_scale=vsc, window=w,
+                tree_mask=tree_words, depths=tree_dep,
+                interpret=INTERP)[0]
+        )(q, kq, vq, k_sc, v_sc, k_new, v_new)
+        ok &= check(f"tree int8 branchy{wname} fwd", masked(out_q),
+                    ref_q, 3e-2)
+    return ok
+
+
 def main() -> int:
     global INTERP
     INTERP = "--interpret" in sys.argv[1:]
@@ -477,6 +666,7 @@ def main() -> int:
 
     ok &= paged_checks()
     ok &= ragged_paged_checks()
+    ok &= ragged_tree_checks()
 
     # RMSNorm.
     x = jax.random.normal(jax.random.key(0), (2, 512, 2048), jnp.bfloat16)

@@ -53,6 +53,14 @@ QUEUE = [
      [sys.executable, str(ROOT / "tools/longcontext_bench.py")], 2700),
     ("prefill_burst",
      [sys.executable, str(ROOT / "tools/prefill_burst_bench.py")], 1800),
+    # Tree-speculation serve probes (ISSUE 11): chain vs tree drafting x
+    # {xla, Mosaic ragged kernel} x {looping, non-looping ambiguous}
+    # workloads — the acceptance-uplift and tokens-per-verify-dispatch
+    # columns, measured on-chip (the --smoke twin rides tier-1). The
+    # matching compiled kernel checks (tree masks, chain-degenerate
+    # bitwise) ride tpu_parity above.
+    ("spec_decode",
+     [sys.executable, str(ROOT / "tools/spec_decode_bench.py")], 2700),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
