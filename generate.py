@@ -67,6 +67,11 @@ def main(argv: list[str] | None = None) -> int:
                              "per-dispatch timing; load in Perfetto); "
                              "sugar for inference.trace=true + "
                              "inference.trace_path=PATH")
+    parser.add_argument("--replicas", type=int, default=None, metavar="N",
+                        help="multi-replica serving: run N engine "
+                             "replicas behind the health-checked router "
+                             "(prefix-affinity placement, circuit-break "
+                             "failover); sugar for router.replicas=N")
     parser.add_argument("--flight-dir", metavar="DIR", default=None,
                         help="flight-recorder postmortem dumps: on a "
                              "degradation trigger (watchdog stall, step "
@@ -121,6 +126,10 @@ def main(argv: list[str] | None = None) -> int:
         overrides.append(f"inference.trace_path={args.trace}")
     if args.flight_dir is not None:
         overrides.append(f"inference.flight_dir={args.flight_dir}")
+    if args.replicas is not None:
+        if args.replicas < 1:
+            raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+        overrides.append(f"router.replicas={args.replicas}")
     cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 
@@ -157,7 +166,15 @@ def main(argv: list[str] | None = None) -> int:
 
     from orion_tpu.runtime.fault import PreemptionHandler
 
-    engine = InferenceEngine(cfg, params, eos_id=args.eos_id)
+    if cfg.router.replicas > 1:
+        # Multi-replica serving (README "Scale-out serving"): the router
+        # mirrors the engine's scheduler face — submit_request/step/
+        # has_work/drain/close — so the loop below drives either.
+        from orion_tpu.infer import Router
+
+        engine = Router(cfg, params, eos_id=args.eos_id)
+    else:
+        engine = InferenceEngine(cfg, params, eos_id=args.eos_id)
     # The engine owns (a possibly int8-quantized copy of) the params from
     # here; keeping this reference alive would pin the full-precision
     # masters in device memory for the whole serving loop.
@@ -182,7 +199,13 @@ def main(argv: list[str] | None = None) -> int:
                     if len(req.generated) > n:
                         print(f"request {req.rid} += {req.generated[n:]}",
                               flush=True)
-                emitted = [len(r.generated) for r in reqs]
+                # High-water mark, never reset: a router failover swaps
+                # the attempt and generated shrinks while the survivor
+                # regenerates — already-printed tokens must not reprint.
+                emitted = [
+                    max(n, len(r.generated))
+                    for n, r in zip(emitted, reqs)
+                ]
     engine.close()
     if args.trace:
         # Re-export explicitly so the success message reflects THIS run

@@ -716,10 +716,22 @@ class InferenceConfig:
     # reaped at step boundaries — pages released, full pages donated to the
     # prefix cache — exactly as preemption does. None = no deadline.
     default_deadline_s: Optional[float] = None
-    # Degradation ladder rung 1: a failed Pallas dispatch retries once on
-    # the XLA reference path (same math, partitioner-visible) before the
+    # Degradation ladder rung 1: a failed Pallas dispatch retries on the
+    # XLA reference path (same math, partitioner-visible) before the
     # step is declared failed. No-op when kernels="xla" already.
     dispatch_fallback: bool = True
+    # How many XLA-fallback retry attempts one dispatch episode gets
+    # (ISSUE 12 satellite). 1 = today's single retry; 0 behaves like
+    # dispatch_fallback=false for the episode; >1 re-attempts the same
+    # fallback program, absorbing multi-shot transients (preempted
+    # neighbors, allocator races) that a single retry loses the step to.
+    dispatch_retries: int = 1
+    # Base for the jittered exponential backoff BETWEEN fallback retry
+    # attempts: attempt i sleeps ~ base * 2^i * U[0.5, 1.0) seconds.
+    # 0.0 (default) keeps today's immediate retry; set it when the fault
+    # source needs wall-clock to clear (device queue drain, neighbor
+    # preemption storm) so N replicas don't re-collide in lockstep.
+    dispatch_retry_backoff_s: float = 0.0
     # Device-side NaN/Inf logit guard: the decode/verify/mixed programs
     # additionally return a per-slot all-finite flag (riding the existing
     # token fetch — no extra round trip) and the engine QUARANTINES a
@@ -815,6 +827,91 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.trace_ring={self.trace_ring} must be >= 1"
             )
+        if self.dispatch_retries is None or self.dispatch_retries < 0:
+            raise ValueError(
+                f"inference.dispatch_retries={self.dispatch_retries} must "
+                f"be >= 0 (0 disables the XLA-fallback retry)"
+            )
+        if (
+            self.dispatch_retry_backoff_s is None
+            or self.dispatch_retry_backoff_s < 0
+        ):
+            raise ValueError(
+                f"inference.dispatch_retry_backoff_s="
+                f"{self.dispatch_retry_backoff_s} must be >= 0"
+            )
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Multi-replica serving router (infer/router.py; ISSUE 12).
+
+    N InferenceEngine replicas behind one scheduler face: prefix-affinity
+    placement (longest radix match wins, load tiebreak off the replica
+    registry gauges), a per-replica health circuit breaker with half-open
+    probing, and failover that re-queues a dead replica's in-flight
+    requests on survivors under a retry budget — every request still ends
+    in exactly one typed outcome. ``replicas=1`` is the plain engine
+    behind a pass-through (byte-identical greedy streams).
+    """
+
+    replicas: int = 1
+    # Prefix-affinity pin threshold: a request whose longest radix match
+    # on SOME replica reaches this many tokens is placed there (ties break
+    # on load); shorter matches route cold to the least-loaded replica.
+    # Matches are page-granular, so sub-page thresholds behave as one page.
+    affinity_min_tokens: int = 16
+    # Failover retry budget per request: how many times a request may be
+    # re-queued onto a survivor after its replica died or circuit-broke
+    # before it is SHED with a typed outcome (never a silent drop).
+    retry_budget: int = 2
+    # Jittered exponential backoff between failover attempts, in ROUTER
+    # steps: attempt i waits base * 2^(i-1) + U{0..jitter} steps before
+    # re-placement. Step-denominated (not wall clock) so the schedule is
+    # deterministic under test and scales with serving cadence.
+    retry_backoff_steps: int = 1
+    retry_backoff_jitter: int = 1
+    # Health circuit breaker: a replica observed unhealthy on this many
+    # CONSECUTIVE router steps trips OPEN (stops receiving placements;
+    # its in-flight work fails over). "Unhealthy" is any of: consecutive
+    # failed engine steps >= break_failed_steps, a watchdog-stalled step
+    # since the last sweep, or >= break_quarantined NaN quarantines since
+    # the last sweep (a poison storm). A replica whose step() RAISES
+    # (DispatchFault/MemoryError escalation) trips immediately.
+    break_after: int = 1
+    break_failed_steps: int = 2
+    break_quarantined: int = 2
+    # OPEN -> HALF_OPEN after this many router steps: the next eligible
+    # request is routed to the replica as a probe; a completed probe
+    # closes the breaker, any new trip re-opens it (and re-arms the
+    # timer), so a flapping replica converges to mostly-open.
+    probe_after_steps: int = 8
+    # Backoff-jitter PRNG seed (placement itself is deterministic).
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.replicas is None or self.replicas < 1:
+            raise ValueError(
+                f"router.replicas={self.replicas} must be >= 1"
+            )
+        if self.retry_budget is None or self.retry_budget < 0:
+            raise ValueError(
+                f"router.retry_budget={self.retry_budget} must be >= 0"
+            )
+        for name in (
+            "affinity_min_tokens", "retry_backoff_steps",
+            "retry_backoff_jitter",
+        ):
+            v = getattr(self, name)
+            if v is None or v < 0:
+                raise ValueError(f"router.{name}={v} must be >= 0")
+        for name in (
+            "break_after", "break_failed_steps", "break_quarantined",
+            "probe_after_steps",
+        ):
+            v = getattr(self, name)
+            if v is None or v < 1:
+                raise ValueError(f"router.{name}={v} must be >= 1")
 
 
 @dataclass(frozen=True)
@@ -843,6 +940,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
 
     def to_dict(self) -> dict:

@@ -13,11 +13,17 @@ With ``inference.speculative`` a host-side prompt-lookup proposer
 scores every slot's drafts in one pass over the weights — up to
 speculate_tokens+1 emitted tokens per dispatch on self-repetitive text,
 greedy output byte-identical, sampled output distribution-preserving.
+The engine itself is split into a scheduler face (``scheduler``:
+Request lifecycle + admission policy) and a dispatch executor
+(``executor``); ``router.Router`` fans requests across N engine replicas
+with prefix-affinity placement, health circuit breakers and typed-outcome
+failover (``router.replicas``).
 """
 
 from orion_tpu.infer.engine import InferenceEngine, Request
 from orion_tpu.infer.kv_cache import PageAllocator, init_cache
 from orion_tpu.infer.prefix_cache import PrefixCache
+from orion_tpu.infer.router import Router, RouterRequest
 from orion_tpu.infer.runner import (
     decode_window,
     mixed_step,
@@ -31,6 +37,8 @@ from orion_tpu.infer.spec_decode import NgramProposer, propose_ngram
 __all__ = [
     "InferenceEngine",
     "Request",
+    "Router",
+    "RouterRequest",
     "NgramProposer",
     "PageAllocator",
     "PrefixCache",

@@ -6,17 +6,23 @@ host drives two jit programs — per-prompt prefill (bucketed static lengths)
 and whole-batch decode (fully static shapes). Requests join mid-flight as
 slots and KV pages free up; batching never changes any request's tokens
 (checked by the equivalence tests in tests/test_infer.py).
+
+ISSUE 12 split the single class into a scheduler face and an executor:
+the request lifecycle + admission-queue policy live in
+``infer/scheduler.py`` (Request, AdmissionQueue), the dispatch programs +
+fault envelope in ``infer/executor.py`` (DispatchExecutor), and this
+class composes them — byte-identical programs and streams to the
+pre-split engine. ``infer/router.py`` fans requests across N of these
+engines as replicas, reading the scheduler face (typed outcomes,
+registry gauges, ``prefix_match_tokens``) and nothing deeper.
 """
 
 from __future__ import annotations
 
 import contextlib
-import dataclasses
 import itertools
 import logging
 import time
-from collections import deque
-from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional, Sequence
 
@@ -25,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.config import Config
+from orion_tpu.infer.executor import DispatchExecutor
 from orion_tpu.infer.kv_cache import (
     PageAllocator,
     copy_page,
@@ -34,13 +41,7 @@ from orion_tpu.infer.kv_cache import (
     rollback_pages,
     scrub_pages,
 )
-from orion_tpu.infer.runner import (
-    decode_window,
-    mixed_step,
-    mixed_verify_step,
-    prefill_step,
-    verify_step,
-)
+from orion_tpu.infer.scheduler import AdmissionQueue, Request, in_flight
 from orion_tpu.infer.sampling import sample
 from orion_tpu.metrics import (
     PrefixCacheStats,
@@ -56,7 +57,6 @@ from orion_tpu.obs import (
 from orion_tpu.runtime.fault import (
     DispatchFault,
     FaultInjector,
-    InjectedFault,
     Watchdog,
 )
 
@@ -80,58 +80,6 @@ def _detect_tp_mesh(params: Any, axis: str = "tp"):
         ):
             return s.mesh
     return None
-
-
-@dataclass
-class Request:
-    rid: int
-    prompt: list[int]
-    max_new_tokens: int
-    generated: list[int] = field(default_factory=list)
-    # Per-request sampling overrides; None = inference.* config defaults.
-    temperature: Optional[float] = None
-    top_k: Optional[int] = None
-    top_p: Optional[float] = None
-    # SLO class (higher = more important): admission and page-pressure
-    # preemption prefer high-priority requests; overload shedding evicts
-    # the lowest class first.
-    priority: int = 0
-    # Absolute time.monotonic() deadline (None = none). Expired requests
-    # are reaped at step boundaries with a typed "expired" outcome.
-    deadline: Optional[float] = None
-    # Typed terminal outcome: "" while live, then exactly one of
-    # "completed" | "expired" | "cancelled" | "shed" | "error:<kind>".
-    # Every submitted request surfaces from step() with an outcome — no
-    # silent drops.
-    outcome: str = ""
-    # scheduler state
-    slot: Optional[int] = None
-    pages: list[int] = field(default_factory=list)
-    done: bool = False
-    admit_seq: int = -1   # admission order; preemption evicts the youngest
-    freed_until: int = 0  # logical pages below this are freed (SWA rolling)
-    # Prefix-cache state: the first n_prefix entries of ``pages`` are
-    # SHARED (refcounted, immutable) cache pages; prefix_node pins their
-    # radix-tree path against eviction until release.
-    n_prefix: int = 0
-    prefix_node: Optional[Any] = None
-    # Chunked-prefill cursor (inference.chunked_prefill): context tokens
-    # whose KV is already in the pool (cached prefix + completed chunks,
-    # always page-aligned until the final chunk). While prefill_pending,
-    # the slot rides mixed steps as a prompt-chunk row, never a decode row.
-    prefill_done: int = 0
-    prefill_pending: bool = False
-
-    @property
-    def context(self) -> list[int]:
-        """Tokens whose KV must be in cache: prompt + everything generated.
-        This is what (re-)prefill runs on, so a preempted request resumes
-        exactly where it left off."""
-        return self.prompt + self.generated
-
-    @property
-    def active(self) -> bool:
-        return self.slot is not None and not self.done
 
 
 class InferenceEngine:
@@ -240,7 +188,9 @@ class InferenceEngine:
         self.seq_lens = np.zeros(self.max_batch, np.int32)
         self.last_token = np.zeros(self.max_batch, np.int32)
         self.slots: list[Optional[Request]] = [None] * self.max_batch
-        self.waiting: deque[Request] = deque()
+        # Scheduler face (infer/scheduler.py): the wait queue carries the
+        # admission-side policy (shed victim selection, deadline sweep).
+        self.waiting: AdmissionQueue = AdmissionQueue()
         self._just_finished: list[Request] = []
         self._rid = itertools.count()
         self._admit_seq = itertools.count()
@@ -280,9 +230,10 @@ class InferenceEngine:
         self._spec_disabled = False
         self._guard = self.icfg.nan_guard
         self.draining = False       # drain(): admission stopped
-        # XLA reference programs, built lazily per dispatch name the first
-        # time a Pallas dispatch fails (inference.dispatch_fallback).
-        self._xla_fallbacks: dict[str, Any] = {}
+        # Executor face (infer/executor.py): the dispatch-program factory,
+        # the lazily-built XLA fallbacks and the per-dispatch fault
+        # envelope all live there; _jit_program/_run_dispatch delegate.
+        self._executor = DispatchExecutor(self)
         # Quarantine primitives: poison is the NaN fault injection
         # (FaultSpec kind="nan"), scrub zeroes a quarantined request's
         # private pages before they return to the free list.
@@ -503,8 +454,12 @@ class InferenceEngine:
             "occupancy": (usable - free) / usable,
         }
         if self._pcache is not None:
-            # held_pages() yields (it walks the radix tree); count it.
-            out["cached_pages"] = sum(1 for _ in self._pcache.held_pages())
+            # total_pages is the incrementally-maintained count of what
+            # held_pages() would walk-and-yield: O(1), which matters now
+            # that the router reads this gauge per placement candidate
+            # (the walk equivalence is covered by assert_page_accounting,
+            # which sums the real held_pages against the allocator).
+            out["cached_pages"] = self._pcache.total_pages
             out["evictable_pages"] = self._pcache.evictable_pages()
         return out
 
@@ -541,136 +496,23 @@ class InferenceEngine:
         returns the number of events written (0 when tracing is off)."""
         return self._tracer.export_chrome(path)
 
-    # -- dispatch + degradation ladder ------------------------------------
-
-    _PROGRAM_FNS = {
-        "prefill": prefill_step,
-        "decode": decode_window,
-        "mixed": mixed_step,
-        "verify": verify_step,
-        "mixed_verify": mixed_verify_step,
-    }
+    # -- dispatch + degradation ladder (infer/executor.py) ----------------
 
     def _jit_program(self, name: str, mcfg, mesh):
-        """Build one jitted dispatch program. ``name`` is a coarse path
-        stem optionally suffixed "_defaults" (python-scalar sampling params
-        bound as trace-time constants — the sort-free greedy
-        specialization). The SAME factory builds the XLA fallback programs
-        (kernels="xla", mesh=None), so the two paths share every static
-        binding and can never drift."""
-        icfg = self.icfg
-        is_default = name.endswith("_defaults")
-        stem = name[: -len("_defaults")] if is_default else name
-        fn = self._PROGRAM_FNS[stem]
-        if stem == "prefill":
-            kw: dict[str, Any] = dict(cfg=mcfg, mesh=mesh)
-        else:
-            kw = dict(
-                cfg=mcfg, max_seq_len=icfg.max_seq_len, mesh=mesh,
-                nan_guard=self._guard,
-            )
-        if is_default:
-            kw.update(
-                temperature=icfg.temperature,
-                top_k=icfg.top_k,
-                top_p=icfg.top_p,
-            )
-        return jax.jit(partial(fn, **kw), donate_argnums=(1,))
+        """Delegate to the executor's program factory (the one factory
+        both primary and XLA-fallback builds share)."""
+        return self._executor.jit_program(name, mcfg, mesh)
 
     def _fallback_program(self, name: str):
-        """The XLA reference program for ``name`` (degradation ladder rung
-        1), or None when no fallback applies — the primary already runs
-        XLA, or inference.dispatch_fallback is off. Built lazily on the
-        first fault and cached; mesh=None because the XLA ops partition
-        from the params' shardings alone."""
-        from orion_tpu.ops._dispatch import resolve_impl
-
-        if not self.icfg.dispatch_fallback:
-            return None
-        if not resolve_impl(self.mcfg.kernels)[0]:
-            return None
-        fb = self._xla_fallbacks.get(name)
-        if fb is None:
-            mcfg_xla = dataclasses.replace(self.mcfg, kernels="xla")
-            fb = self._jit_program(name, mcfg_xla, None)
-            self._xla_fallbacks[name] = fb
-        return fb
+        return self._executor.fallback_program(name)
 
     def _run_dispatch(self, path: str, name: str, *args, **kwargs):
-        """Run one device dispatch with the fault-tolerance envelope: the
-        injection points (stall sleeps; dispatch exceptions raised BEFORE
-        the primary call, so engine/cache state is untouched and retry is
-        sound), then on ANY failure one retry on the XLA reference path.
-        Raises DispatchFault(path) when every path is exhausted — the
-        engine fails the step, not the process.
-
-        The primary result is blocked on HERE so that execute-time device
-        errors (async dispatch defers them to the first fetch) surface
-        inside this envelope instead of crashing the caller's device_get;
-        the engine fetches the step's tokens immediately afterwards
-        anyway, so no overlap is lost. Fallback scope: trace/compile/
-        lowering failures (the dominant Pallas fault class) and injected
-        faults retry cleanly; an EXECUTE-time failure may already have
-        consumed the donated cache buffer, in which case the fallback
-        double-faults and the episode is contained as a failed step."""
-        inj = self._injector
-        if inj is not None:
-            st = inj.take("stall", self.step_no, path)
-            if st is not None:
-                log.warning(
-                    "injected %.2fs stall in %s dispatch (step %d)",
-                    st.stall_s, path, self.step_no,
-                )
-                time.sleep(st.stall_s)
-        try:
-            if inj is not None and (
-                inj.take("dispatch", self.step_no, path) is not None
-            ):
-                raise InjectedFault(
-                    f"injected {path} dispatch fault (step {self.step_no})"
-                )
-            # TraceAnnotation (not a host-ring span — _device_span owns
-            # that window): names this dispatch in a concurrently-captured
-            # device profile so xprof rows align with the Chrome export.
-            with self._tracer.annotation("orion/" + path):
-                out = getattr(self, "_" + name)(*args, **kwargs)
-                jax.block_until_ready(out)
-            return out
-        except Exception as e:
-            self.robust.dispatch_faults += 1
-            self._flight_note(
-                "dispatch_fault", path=path,
-                error=f"{type(e).__name__}: {e}",
-            )
-            if path in ("verify", "mixed_verify"):
-                # Degradation ladder rung 2 counts PRIMARY verify faults
-                # here — before the fallback — so a persistently broken
-                # verify kernel disables speculation even when every
-                # episode is absorbed by a successful XLA retry (otherwise
-                # the engine would pay a doomed primary attempt + fallback
-                # on every verify step forever).
-                self._note_spec_fault(e)
-            fb = self._fallback_program(name)
-            if fb is None:
-                raise DispatchFault(
-                    path, f"{type(e).__name__}: {e}"
-                ) from e
-            log.warning(
-                "%s dispatch failed (%s: %s); retrying once on the XLA "
-                "reference path", path, type(e).__name__, e,
-            )
-            try:
-                with self._tracer.annotation("orion/" + path + "/fallback"):
-                    out = fb(*args, **kwargs)
-                    jax.block_until_ready(out)
-            except Exception as e2:
-                self.robust.dispatch_faults += 1
-                raise DispatchFault(
-                    path, f"xla fallback failed too: {e2}"
-                ) from e2
-            self.robust.dispatch_fallbacks += 1
-            self._flight_note("dispatch_fallback", path=path)
-            return out
+        """Run one device dispatch under the executor's fault-tolerance
+        envelope (injection points, XLA-fallback retry ladder with
+        ``inference.dispatch_retries`` jittered-backoff attempts); raises
+        DispatchFault when every path is exhausted — the engine fails the
+        step, not the process."""
+        return self._executor.run(path, name, *args, **kwargs)
 
     def _note_spec_fault(self, e: Exception) -> None:
         """Degradation ladder rung 2: count a verify-path PRIMARY dispatch
@@ -765,20 +607,11 @@ class InferenceEngine:
         "expired"; active ones release pages with prefix-cache donation
         exactly as preemption does (the _reap path)."""
         now = time.monotonic()
-        if self.waiting and any(
-            r.deadline is not None and now >= r.deadline
-            for r in self.waiting
-        ):
-            keep: deque[Request] = deque()
-            for r in self.waiting:
-                if r.deadline is not None and now >= r.deadline:
-                    r.done = True
-                    r.outcome = "expired"
-                    self.robust.expired += 1
-                    self._just_finished.append(r)
-                else:
-                    keep.append(r)
-            self.waiting = keep
+        for r in self.waiting.sweep_expired(now):
+            r.done = True
+            r.outcome = "expired"
+            self.robust.expired += 1
+            self._just_finished.append(r)
         for r in self.slots:
             if (
                 r is not None and not r.done
@@ -929,14 +762,7 @@ class InferenceEngine:
             # In-flight requests (admitted once, or carrying generated
             # tokens — see _in_flight) are never victims: "shed" means
             # never admitted (RobustnessStats contract).
-            victim = min(
-                [r for r in self.waiting if not self._in_flight(r)] + [req],
-                key=lambda r: (
-                    r.priority,
-                    r.deadline if r.deadline is not None else float("inf"),
-                    -r.rid,
-                ),
-            )
+            victim = self.waiting.shed_victim(req)
             self._shed(victim, f"queue full ({qlim})")
             if victim is not req:
                 self.waiting.remove(victim)
@@ -945,15 +771,10 @@ class InferenceEngine:
         self.waiting.append(req)
         return req
 
-    @staticmethod
-    def _in_flight(req: Request) -> bool:
-        """A queued request that has RUN: admitted at least once and not
-        since un-claimed (admit_seq >= 0 — preemption and fault unwinds
-        keep it), or carrying generated tokens from a previous residency
-        (survives even an admission pool-fault deferral, which resets
-        admit_seq). In-flight requests are exempt from overload shedding
-        and are finished — not shed — by drain()."""
-        return req.admit_seq >= 0 or bool(req.generated)
+    # In-flight test (scheduler face): admitted at least once, or carrying
+    # generated tokens — exempt from overload shedding, finished (not
+    # shed) by drain(). See infer/scheduler.py.
+    _in_flight = staticmethod(in_flight)
 
     def _shed(self, req: Request, why: str) -> None:
         log.warning("shedding request %d (priority %d): %s",
@@ -1252,6 +1073,42 @@ class InferenceEngine:
             or any(r is not None for r in self.slots)
         )
 
+    # -- router-facing scheduler face (infer/router.py) --------------------
+
+    @property
+    def consec_failed_steps(self) -> int:
+        """Consecutive failed step() calls (0 after any successful step) —
+        the router's primary liveness signal for this replica; the engine
+        itself re-raises at inference.max_step_faults."""
+        return self._consec_failed
+
+    def prefix_match_tokens(self, context: Sequence[int]) -> int:
+        """Tokens of ``context`` this replica could serve from its radix
+        prefix index right now — the router's prefix-affinity placement
+        signal. Read-only (PrefixCache.peek: no locks, no LRU stamps, no
+        edge splits), so probing N replicas never perturbs any tree. 0
+        with the prefix cache off.
+
+        Mirrors _match_prefix's USABILITY gates, not just its cap: a
+        match below prefix_cache_min_pages, or shallower than the SWA
+        dead-page boundary, is one admission would reject — advertising
+        it would affinity-pin placements that then prefill cold."""
+        if self._pcache is None:
+            return 0
+        cap = len(context) // self.psz
+        if self.page_window is not None:
+            # Mirror _match_prefix's SWA cap: a full-context match is
+            # never usable there, so do not advertise it.
+            cap = (len(context) - 1) // self.psz
+        pages = self._pcache.peek(context, cap)
+        if pages < max(self.icfg.prefix_cache_min_pages, 1):
+            return 0
+        if self.page_window is not None and (
+            pages < self._first_live_page(len(context))
+        ):
+            return 0
+        return pages * self.psz
+
     def drain(self) -> list[Request]:
         """Graceful shutdown (the SIGTERM path, wired in generate.py via
         PreemptionHandler): stop admission, shed the wait queue with typed
@@ -1260,10 +1117,10 @@ class InferenceEngine:
         request that terminated during the drain. Leaves the pool fully
         accounted (assert_page_accounting)."""
         self.draining = True
-        keep: deque[Request] = deque()
+        keep: AdmissionQueue = AdmissionQueue()
         while self.waiting:
             r = self.waiting.popleft()
-            if self._in_flight(r):
+            if in_flight(r):
                 # Preempted back into the queue after running: in-flight
                 # work the drain contract finishes, not sheds.
                 keep.append(r)
@@ -1280,7 +1137,13 @@ class InferenceEngine:
         """Stop the serving watchdog thread, flush the metrics exporters
         and export the Chrome trace when inference.trace_path is set.
         Idempotent: the flush/export half runs once — a second close must
-        not append a spurious all-zero row to the metrics time series."""
+        not append a spurious all-zero row to the metrics time series.
+
+        Admission stops permanently: a submit() after close() yields a
+        typed "shed" outcome exactly like one after drain() — it must
+        never queue work no step loop will ever run (ISSUE 12 lifecycle
+        hardening; the router leans on this when retiring replicas)."""
+        self.draining = True
         if not self._closed:
             self._closed = True
             if self.icfg.metrics_jsonl or self.icfg.metrics_prom:

@@ -1,0 +1,206 @@
+"""Dispatch executor of the serving engine (ISSUE 12 tentpole split).
+
+The other half of the scheduler/executor split (see infer/scheduler.py):
+this module owns the *device-facing* machinery the engine delegates to —
+the jitted dispatch-program factory (primary and XLA-fallback builds
+share one code path so they can never drift), and the per-dispatch
+fault-tolerance envelope: injection points, the degradation-ladder
+fallback retry loop (``inference.dispatch_retries`` attempts with
+jittered backoff between them — ISSUE 12 satellite), and the
+DispatchFault contract the engine's failed-step containment consumes.
+
+The executor holds a back-reference to its engine rather than copies of
+the engine's mutable state (robust stats, injector, tracer): those
+objects are swapped by ``reset_timing``/lifecycle paths and the envelope
+must always read the live ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from functools import partial
+from typing import Any
+
+import jax
+
+from orion_tpu.infer.runner import (
+    decode_window,
+    mixed_step,
+    mixed_verify_step,
+    prefill_step,
+    verify_step,
+)
+from orion_tpu.runtime.fault import DispatchFault, InjectedFault
+
+log = logging.getLogger("orion_tpu.infer")
+
+
+class DispatchExecutor:
+    """Owns the engine's dispatch programs and the fault envelope around
+    every device call (previously ``InferenceEngine._jit_program`` /
+    ``_fallback_program`` / ``_run_dispatch``, relocated verbatim plus
+    the configurable-retry satellite)."""
+
+    PROGRAM_FNS = {
+        "prefill": prefill_step,
+        "decode": decode_window,
+        "mixed": mixed_step,
+        "verify": verify_step,
+        "mixed_verify": mixed_verify_step,
+    }
+
+    def __init__(self, engine):
+        self.eng = engine
+        # XLA reference programs, built lazily per dispatch name the first
+        # time a Pallas dispatch fails (inference.dispatch_fallback).
+        self._xla_fallbacks: dict[str, Any] = {}
+        # Backoff jitter source. Fixed seed so a replayed fault episode
+        # sleeps the same schedule; sleep durations never touch tokens,
+        # so this is log-determinism, not output-determinism.
+        self._rng = random.Random(0)
+
+    def jit_program(self, name: str, mcfg, mesh):
+        """Build one jitted dispatch program. ``name`` is a coarse path
+        stem optionally suffixed "_defaults" (python-scalar sampling params
+        bound as trace-time constants — the sort-free greedy
+        specialization). The SAME factory builds the XLA fallback programs
+        (kernels="xla", mesh=None), so the two paths share every static
+        binding and can never drift."""
+        icfg = self.eng.icfg
+        is_default = name.endswith("_defaults")
+        stem = name[: -len("_defaults")] if is_default else name
+        fn = self.PROGRAM_FNS[stem]
+        if stem == "prefill":
+            kw: dict[str, Any] = dict(cfg=mcfg, mesh=mesh)
+        else:
+            kw = dict(
+                cfg=mcfg, max_seq_len=icfg.max_seq_len, mesh=mesh,
+                nan_guard=self.eng._guard,
+            )
+        if is_default:
+            kw.update(
+                temperature=icfg.temperature,
+                top_k=icfg.top_k,
+                top_p=icfg.top_p,
+            )
+        return jax.jit(partial(fn, **kw), donate_argnums=(1,))
+
+    def fallback_program(self, name: str):
+        """The XLA reference program for ``name`` (degradation ladder rung
+        1), or None when no fallback applies — the primary already runs
+        XLA, or inference.dispatch_fallback is off / retry count 0. Built
+        lazily on the first fault and cached; mesh=None because the XLA
+        ops partition from the params' shardings alone."""
+        from orion_tpu.ops._dispatch import resolve_impl
+
+        eng = self.eng
+        if not eng.icfg.dispatch_fallback or eng.icfg.dispatch_retries < 1:
+            return None
+        if not resolve_impl(eng.mcfg.kernels)[0]:
+            return None
+        fb = self._xla_fallbacks.get(name)
+        if fb is None:
+            mcfg_xla = dataclasses.replace(eng.mcfg, kernels="xla")
+            fb = self.jit_program(name, mcfg_xla, None)
+            self._xla_fallbacks[name] = fb
+        return fb
+
+    def _backoff(self, attempt: int) -> None:
+        """Jittered exponential backoff between fallback attempts
+        (inference.dispatch_retry_backoff_s; 0.0 = today's immediate
+        retry). Full jitter on the upper half keeps a fleet of replicas
+        retrying a shared transient from re-colliding in lockstep."""
+        base = self.eng.icfg.dispatch_retry_backoff_s
+        if base <= 0.0:
+            return
+        time.sleep(base * (2 ** attempt) * (0.5 + 0.5 * self._rng.random()))
+
+    def run(self, path: str, name: str, *args, **kwargs):
+        """Run one device dispatch with the fault-tolerance envelope: the
+        injection points (stall sleeps; dispatch exceptions raised BEFORE
+        the primary call, so engine/cache state is untouched and retry is
+        sound), then on ANY failure up to ``inference.dispatch_retries``
+        retries on the XLA reference path, jittered backoff between
+        attempts. Raises DispatchFault(path) when every path is exhausted
+        — the engine fails the step, not the process.
+
+        The primary result is blocked on HERE so that execute-time device
+        errors (async dispatch defers them to the first fetch) surface
+        inside this envelope instead of crashing the caller's device_get;
+        the engine fetches the step's tokens immediately afterwards
+        anyway, so no overlap is lost. Fallback scope: trace/compile/
+        lowering failures (the dominant Pallas fault class) and injected
+        faults retry cleanly; an EXECUTE-time failure may already have
+        consumed the donated cache buffer, in which case the fallback
+        double-faults and the episode is contained as a failed step."""
+        eng = self.eng
+        inj = eng._injector
+        if inj is not None:
+            st = inj.take("stall", eng.step_no, path)
+            if st is not None:
+                log.warning(
+                    "injected %.2fs stall in %s dispatch (step %d)",
+                    st.stall_s, path, eng.step_no,
+                )
+                time.sleep(st.stall_s)
+        try:
+            if inj is not None and (
+                inj.take("dispatch", eng.step_no, path) is not None
+            ):
+                raise InjectedFault(
+                    f"injected {path} dispatch fault (step {eng.step_no})"
+                )
+            # TraceAnnotation (not a host-ring span — _device_span owns
+            # that window): names this dispatch in a concurrently-captured
+            # device profile so xprof rows align with the Chrome export.
+            with eng._tracer.annotation("orion/" + path):
+                out = getattr(eng, "_" + name)(*args, **kwargs)
+                jax.block_until_ready(out)
+            return out
+        except Exception as e:
+            eng.robust.dispatch_faults += 1
+            eng._flight_note(
+                "dispatch_fault", path=path,
+                error=f"{type(e).__name__}: {e}",
+            )
+            if path in ("verify", "mixed_verify"):
+                # Degradation ladder rung 2 counts PRIMARY verify faults
+                # here — before the fallback — so a persistently broken
+                # verify kernel disables speculation even when every
+                # episode is absorbed by a successful XLA retry (otherwise
+                # the engine would pay a doomed primary attempt + fallback
+                # on every verify step forever).
+                eng._note_spec_fault(e)
+            fb = self.fallback_program(name)
+            if fb is None:
+                raise DispatchFault(
+                    path, f"{type(e).__name__}: {e}"
+                ) from e
+            last: Exception = e
+            for attempt in range(eng.icfg.dispatch_retries):
+                self._backoff(attempt)
+                eng.robust.dispatch_retries += 1
+                log.warning(
+                    "%s dispatch failed (%s: %s); retry %d/%d on the XLA "
+                    "reference path", path, type(last).__name__, last,
+                    attempt + 1, eng.icfg.dispatch_retries,
+                )
+                try:
+                    with eng._tracer.annotation(
+                        "orion/" + path + "/fallback"
+                    ):
+                        out = fb(*args, **kwargs)
+                        jax.block_until_ready(out)
+                except Exception as e2:
+                    eng.robust.dispatch_faults += 1
+                    last = e2
+                    continue
+                eng.robust.dispatch_fallbacks += 1
+                eng._flight_note("dispatch_fallback", path=path)
+                return out
+            raise DispatchFault(
+                path, f"xla fallback failed too: {last}"
+            ) from last

@@ -173,6 +173,31 @@ class PrefixCache:
         self.lock(node)
         return pages, node
 
+    def peek(self, tokens, max_pages: int) -> int:
+        """Pages of the longest cached page-granular prefix of ``tokens``
+        (capped at ``max_pages``) — the READ-ONLY twin of ``match()``:
+        no locks taken, no LRU stamps touched, no edge splits. The
+        multi-replica router probes every replica's tree per placement
+        (infer/router.py prefix affinity), and a probe must never mutate
+        a tree it then routes AWAY from."""
+        node = self.root
+        i = 0
+        matched = 0
+        while max_pages > 0 and i + self.psz <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + self.psz]))
+            if child is None:
+                break
+            m = self._match_edge(child, tokens, i, max_pages)
+            if m == 0:
+                break
+            matched += m
+            i += m * self.psz
+            max_pages -= m
+            if m < len(child.pages):
+                break   # match ends inside this edge: nothing deeper
+            node = child
+        return matched
+
     def lock(self, node: _Node) -> None:
         while node is not None:
             if node.lock == 0:

@@ -1,0 +1,780 @@
+"""Multi-replica serving router (ISSUE 12 tentpole).
+
+One engine is 100% of capacity; N engines behind this router make any
+single wedged, poisoned or killed replica 1/N with automatic failover.
+The router is a pure *scheduler-face* consumer (infer/scheduler.py): a
+replica is "somewhere requests can be admitted, with typed outcomes and
+registry gauges" — it never reaches into KV pools or dispatch programs.
+
+Three mechanisms, each riding substrate earlier PRs built:
+
+  - **Prefix-affinity placement.** Every placement probes each routable
+    replica's radix prefix index read-only (``PrefixCache.peek`` via
+    ``engine.prefix_match_tokens``); the longest match >=
+    ``router.affinity_min_tokens`` pins the replica (warm KV pages beat a
+    cold prefill), ties and cold requests break on LOAD read from the
+    replica's metrics registry — pool occupancy, queue depth, per-window
+    device-seconds-per-slot-step (the ITL proxy) — never ad-hoc counters.
+  - **Health circuit breaker.** A per-replica breaker driven by the
+    engine's own robustness signals: consecutive failed steps, watchdog
+    stalls and NaN-quarantine storms observed per router step, plus hard
+    escalations (an engine ``step()`` that RAISES DispatchFault /
+    MemoryError). Tripping OPENs the breaker — no new placements, the
+    replica's in-flight work fails over — and after
+    ``router.probe_after_steps`` the breaker goes HALF_OPEN: the next
+    eligible request is routed as a probe; a completed probe closes the
+    breaker, any failure re-opens it.
+  - **Failover.** Requests on a dead/broken replica are re-queued on
+    survivors under ``router.retry_budget`` with jittered step-count
+    backoff. Every request still ends in EXACTLY one typed outcome —
+    retried-then-completed, or shed when the budget/survivors run out;
+    never a silent drop, never a double emission (``stream()`` dedups on
+    a high-water mark, and greedy regeneration is deterministic, so a
+    retried request's stream is the uninterrupted stream).
+
+Router decisions (route / retry / break / probe) are emitted as tracer
+instants and flight-recorder events, exactly like the engine's own
+request lifecycle. ``router.replicas=1`` is a pass-through: byte-identical
+greedy streams to the bare engine (pinned in tests/test_router.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import random
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from orion_tpu.config import Config
+from orion_tpu.infer.engine import InferenceEngine
+from orion_tpu.infer.scheduler import Request
+from orion_tpu.metrics import RouterStats
+from orion_tpu.obs import MetricsRegistry, export_chrome_safe, init_obs
+from orion_tpu.runtime.fault import (
+    DispatchFault,
+    FaultInjector,
+    FaultSpec,
+)
+
+log = logging.getLogger("orion_tpu.router")
+
+# Circuit-breaker states (the canonical three-state breaker): CLOSED =
+# healthy and routable, OPEN = broken (no placements), HALF_OPEN = one
+# probe request allowed through to test recovery.
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+@dataclass
+class RouterRequest:
+    """One request as the router sees it: the durable identity (prompt +
+    sampling params + SLO class) that survives failover, pointing at the
+    CURRENT engine-side attempt. ``outcome`` is set exactly once."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: Optional[int]
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    priority: int = 0
+    # Absolute monotonic deadline carried ACROSS attempts: a failover
+    # re-placement passes the remaining budget, not a fresh window.
+    deadline: Optional[float] = None
+    outcome: str = ""           # "" while live; exactly one typed outcome
+    retries: int = 0            # failover re-queues consumed
+    replica: Optional[int] = None
+    attempt: Optional[Request] = None   # live engine-side request
+    due_step: int = 0           # backoff gate: no placement before this
+    emitted: int = 0            # stream() high-water mark (dedup)
+    placed: bool = False        # ever admitted to some engine
+
+    @property
+    def generated(self) -> list[int]:
+        """Tokens of the CURRENT attempt (a failover restarts from the
+        prompt; greedy regeneration reproduces the lost prefix)."""
+        return self.attempt.generated if self.attempt is not None else []
+
+    @property
+    def done(self) -> bool:
+        return bool(self.outcome)
+
+
+class ReplicaHandle:
+    """One replica: the engine, its dedicated fault injector (the funnel
+    replica-scoped fault specs forward through) and the breaker state."""
+
+    def __init__(self, idx: int, engine: InferenceEngine,
+                 injector: FaultInjector):
+        self.idx = idx
+        self.engine = engine
+        self.injector = injector
+        self.state = CLOSED
+        self.dead = False           # killed: never stepped again
+        self.opened_at = 0          # router step of the last OPEN trip
+        self.unhealthy = 0          # consecutive unhealthy health sweeps
+        self.probe_rid: Optional[int] = None   # engine rid of the probe
+        # engine rid -> RouterRequest for everything placed here
+        self.inflight: dict[int, RouterRequest] = {}
+        # Absolute robust-counter watermarks for delta-based health signals
+        # (clamped re-base survives an engine reset_timing mid-flight).
+        self.seen = {"stalled": 0, "quarantined": 0}
+
+    @property
+    def routable(self) -> bool:
+        return not self.dead and (
+            self.state == CLOSED
+            or (self.state == HALF_OPEN and self.probe_rid is None)
+        )
+
+
+class Router:
+    """Fan ``submit()`` across N InferenceEngine replicas (see module
+    docstring). The public surface mirrors the engine's scheduler face —
+    submit/submit_request, step, has_work, drain, close, generate,
+    stream, reset_timing — so callers written against one engine port by
+    construction."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        *,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+        fault_injector: Optional[FaultInjector] = None,
+    ):
+        self.cfg = cfg
+        self.rcfg = cfg.router
+        self.icfg = cfg.inference
+        # Replica engines own no export targets: the ROUTER exports the
+        # trace/metrics (N engines rewriting one trace_path/prom file
+        # would clobber each other); flight dumps stay per-engine (file
+        # names are unique) so a replica postmortem is still written.
+        rep_icfg = dataclasses.replace(
+            cfg.inference,
+            trace_path=None, metrics_jsonl=None, metrics_prom=None,
+        )
+        rep_cfg = dataclasses.replace(cfg, inference=rep_icfg)
+        self.handles: list[ReplicaHandle] = []
+        for i in range(self.rcfg.replicas):
+            inj = FaultInjector()
+            eng = InferenceEngine(
+                rep_cfg, params, eos_id=eos_id, seed=seed + i,
+                fault_injector=inj,
+            )
+            self.handles.append(ReplicaHandle(i, eng, inj))
+        self._injector = fault_injector
+        self.stats = RouterStats()
+        self.step_no = 0
+        self.draining = False
+        self._closed = False
+        self.waiting: deque[RouterRequest] = deque()
+        self._just_finished: list[RouterRequest] = []
+        self._rid = itertools.count()
+        self._rng = random.Random(self.rcfg.seed)
+        self.registry = MetricsRegistry()
+        self.registry.register("router", self._router_metrics)
+        self._tracer, self._flight = init_obs(
+            trace=self.icfg.trace,
+            trace_ring=self.icfg.trace_ring,
+            flight_dir=self.icfg.flight_dir,
+            trace_path=self.icfg.trace_path,
+            snapshot=self.registry.snapshot,
+            injector=fault_injector,
+        )
+
+    # -- observability -----------------------------------------------------
+
+    def _router_metrics(self) -> dict:
+        by_state = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        for h in self.handles:
+            by_state[h.state] += 1
+        return {
+            **self.stats.as_timing(),
+            "replicas": len(self.handles),
+            "replicas_closed": by_state[CLOSED],
+            "replicas_open": by_state[OPEN],
+            "replicas_half_open": by_state[HALF_OPEN],
+            "replicas_dead": sum(1 for h in self.handles if h.dead),
+            "queue_depth": len(self.waiting),
+            "step_no": self.step_no,
+        }
+
+    def _flight_note(self, kind: str, **fields) -> None:
+        if self._flight is not None:
+            self._flight.note(kind, step=self.step_no, **fields)
+
+    def export_trace(self, path: str) -> int:
+        """Export the router's span ring (route/retry/break/probe plus
+        request lifecycle) as Chrome trace-event JSON."""
+        return self._tracer.export_chrome(path)
+
+    def reset_timing(self) -> dict:
+        """Drain the router-level counters (RouterStats) plus breaker/
+        queue gauges. Per-replica serving windows stay with each engine's
+        own ``reset_timing`` — the router never aggregates them away."""
+        out = self._router_metrics()
+        self.stats = RouterStats()
+        return out
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None, **kw) -> int:
+        """Queue a request; returns its router-level id (engine-side rids
+        are per-replica and change across failover)."""
+        return self.submit_request(prompt, max_new_tokens, **kw).rid
+
+    def submit_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        *,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        priority: int = 0,
+    ) -> RouterRequest:
+        """Admit one request to the fleet. Placement is immediate when a
+        routable replica exists (engine-side validation errors raise here
+        exactly as the bare engine's would); with every breaker OPEN the
+        request waits at the router, and with every replica DEAD (or the
+        router draining) it is SHED with a typed outcome — surfacing from
+        the next ``step()``, never silently dropped."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if deadline_s is None:
+            # Resolve the config default HERE so the absolute deadline is
+            # carried across failover attempts (each re-placement passes
+            # the REMAINING budget) — leaving it to the engine would hand
+            # every retry a fresh default window.
+            deadline_s = self.icfg.default_deadline_s
+        rr = RouterRequest(
+            rid=next(self._rid),
+            prompt=list(map(int, prompt)),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            priority=int(priority),
+            deadline=(
+                time.monotonic() + deadline_s
+                if deadline_s is not None else None
+            ),
+        )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "submit", rid=rr.rid, priority=rr.priority,
+                prompt_tokens=len(rr.prompt), deadline_s=deadline_s,
+            )
+        if self.draining:
+            self._shed(rr, "draining", self._just_finished)
+            return rr
+        if all(h.dead for h in self.handles):
+            self._shed(rr, "all replicas down", self._just_finished)
+            return rr
+        placed = self._try_place(rr, self._just_finished,
+                                 raise_errors=True)
+        if not placed and not rr.done:
+            self.waiting.append(rr)
+        return rr
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a router request by id; returns False when unknown or
+        already terminal. A router-queued request terminates immediately;
+        a placed one cancels on its replica and surfaces at the next
+        step boundary with outcome "cancelled"."""
+        for i, rr in enumerate(self.waiting):
+            if rr.rid == rid:
+                del self.waiting[i]
+                self._finalize(rr, "cancelled", self._just_finished)
+                return True
+        for h in self.handles:
+            for erid, rr in h.inflight.items():
+                if rr.rid == rid:
+                    return h.engine.cancel(erid)
+        return False
+
+    def has_work(self) -> bool:
+        return (
+            bool(self.waiting)
+            or bool(self._just_finished)
+            or any(h.inflight for h in self.handles)
+            or any(
+                not h.dead and h.engine.has_work() for h in self.handles
+            )
+        )
+
+    def step(self) -> list[RouterRequest]:
+        """One router step: fire replica-scoped fault specs, sweep
+        health (breaker trips + failover), advance OPEN breakers toward
+        HALF_OPEN, place due queued requests, then step every live
+        replica with work and surface finished requests — each with
+        exactly one typed outcome."""
+        done: list[RouterRequest] = self._just_finished
+        self._just_finished = []
+        self._fire_replica_faults(done)
+        self._sweep_health(done)
+        self._open_to_half_open()
+        self._dispatch_queue(done)
+        for h in self.handles:
+            if h.dead or not h.engine.has_work():
+                continue
+            try:
+                finished = h.engine.step()
+            except (DispatchFault, MemoryError) as e:
+                # The engine's own containment gave up (max_step_faults
+                # consecutive losses, or an unrecoverable pool fault):
+                # that is a broken replica, not a broken fleet.
+                self._break(
+                    h, done,
+                    f"step raised {type(e).__name__}: {e}",
+                )
+                continue
+            for er in finished:
+                rr = h.inflight.pop(er.rid, None)
+                if rr is None:
+                    continue    # failed over / cancelled by the router
+                self._finish(h, rr, er, done)
+        self.step_no += 1
+        return done
+
+    def drain(self) -> list[RouterRequest]:
+        """Graceful fleet shutdown: stop admission, shed never-placed
+        queue entries with typed outcomes, finish (or fail over) every
+        in-flight request, and return everything that terminated during
+        the drain."""
+        self.draining = True
+        keep: deque[RouterRequest] = deque()
+        drained: list[RouterRequest] = []
+        while self.waiting:
+            rr = self.waiting.popleft()
+            if rr.placed:
+                # Failover work the drain contract finishes, not sheds.
+                keep.append(rr)
+            else:
+                self._shed(rr, "draining", drained)
+        self.waiting = keep
+        while self.has_work():
+            drained.extend(self.step())
+        return drained
+
+    def close(self) -> None:
+        """Close every live replica (dead replicas model a killed process
+        — only their watchdog thread is reaped) and export the router's
+        trace when inference.trace_path is set. Idempotent; admission
+        stays stopped afterwards."""
+        self.draining = True
+        if self._closed:
+            return
+        self._closed = True
+        for h in self.handles:
+            if h.dead:
+                if h.engine._watchdog is not None:
+                    h.engine._watchdog.stop()
+            else:
+                h.engine.close()
+        export_chrome_safe(self._tracer, self.icfg.trace_path)
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ) -> list[list[int]]:
+        """Convenience drain loop: generated tokens per prompt, in
+        submission order (shed requests yield [])."""
+        reqs = [self.submit_request(p, max_new_tokens) for p in prompts]
+        while self.has_work():
+            self.step()
+        return [list(r.generated) for r in reqs]
+
+    def stream(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ):
+        """Incremental drain loop: yields ``(rid, new_tokens)`` per
+        advanced request per router step. Emission is high-water-marked
+        per request, so a failover NEVER double-emits: the new attempt's
+        regenerated prefix is swallowed up to what was already yielded
+        (greedy regeneration reproduces it exactly; sampled retries may
+        diverge from the lost tail — the distribution, not the bytes, is
+        the sampled contract)."""
+        reqs = [self.submit_request(p, max_new_tokens) for p in prompts]
+        pending = set(range(len(reqs)))
+        while pending:
+            self.step()
+            for i in sorted(pending):
+                rr = reqs[i]
+                gen = rr.generated
+                if len(gen) > rr.emitted:
+                    yield rr.rid, gen[rr.emitted:]
+                    rr.emitted = len(gen)
+                if rr.done and rr.emitted >= len(gen):
+                    if rr.emitted == 0:
+                        # Zero-token terminal (shed, scoring): announce
+                        # the rid exactly once, like the engine does.
+                        yield rr.rid, []
+                    pending.discard(i)
+
+    # -- breaker + failover internals --------------------------------------
+
+    def _fire_replica_faults(self, done: list[RouterRequest]) -> None:
+        """Replica-scoped FaultSpec kinds (runtime/fault.py): kill is a
+        router-level event (sudden process death); stall and poison
+        forward into the victim engine's own injector so the fault flows
+        through the REAL engine code paths the health sweep then reads."""
+        inj = self._injector
+        if inj is None:
+            return
+        for kind in FaultSpec.REPLICA_KINDS:
+            while True:
+                spec = inj.take(kind, self.step_no)
+                if spec is None:
+                    break
+                if spec.replica >= len(self.handles):
+                    log.warning("%s: no replica %d", kind, spec.replica)
+                    continue
+                h = self.handles[spec.replica]
+                if kind == "replica_kill":
+                    if not h.dead:
+                        self._break(h, done, "killed (injected)",
+                                    kill=True)
+                elif kind == "replica_stall":
+                    h.injector.specs.append(FaultSpec(
+                        "stall", step=h.engine.step_no,
+                        stall_s=spec.stall_s,
+                    ))
+                else:   # replica_poison
+                    h.injector.specs.append(FaultSpec(
+                        "nan", step=h.engine.step_no, rid=spec.rid,
+                    ))
+
+    def _delta(self, h: ReplicaHandle, key: str, current: int) -> int:
+        """Clamped watermark delta over an engine robust counter: an
+        engine-side reset_timing (which swaps the stats object) re-bases
+        instead of producing a negative delta."""
+        d = max(current - h.seen[key], 0)
+        h.seen[key] = current
+        return d
+
+    def _sweep_health(self, done: list[RouterRequest]) -> None:
+        """Per-step health read of every live replica off its OWN
+        signals: consecutive failed steps, watchdog stalls and NaN
+        quarantines since the last sweep. Only CLOSED replicas are judged
+        (an OPEN/HALF_OPEN replica's stale counters must not pre-empt its
+        probe), but watermarks advance for all so recovery starts with a
+        clean slate."""
+        rcfg = self.rcfg
+        for h in self.handles:
+            if h.dead:
+                continue
+            r = h.engine.robust
+            stalled_d = self._delta(h, "stalled", r.stalled_steps)
+            quar_d = self._delta(h, "quarantined", r.quarantined)
+            if h.state != CLOSED:
+                continue
+            unhealthy = (
+                h.engine.consec_failed_steps >= rcfg.break_failed_steps
+                or stalled_d > 0
+                or quar_d >= rcfg.break_quarantined
+            )
+            if not unhealthy:
+                h.unhealthy = 0
+                continue
+            h.unhealthy += 1
+            if h.unhealthy >= rcfg.break_after:
+                self._break(
+                    h, done,
+                    f"unhealthy: consec_failed="
+                    f"{h.engine.consec_failed_steps} stalled+={stalled_d} "
+                    f"quarantined+={quar_d}",
+                )
+
+    def _break(
+        self,
+        h: ReplicaHandle,
+        done: list[RouterRequest],
+        reason: str,
+        kill: bool = False,
+    ) -> None:
+        """Trip the breaker OPEN (or mark the replica dead) and fail over
+        everything in flight there. On a soft break the engine is still
+        alive: its requests are cancelled so their pages free at its next
+        step; a killed replica is never touched again."""
+        log.error("replica %d circuit-break OPEN: %s", h.idx, reason)
+        h.state = OPEN
+        h.opened_at = self.step_no
+        h.unhealthy = 0
+        h.probe_rid = None
+        self.stats.breaks += 1
+        if kill:
+            h.dead = True
+            self.stats.kills += 1
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "break", replica=h.idx, reason=reason, killed=kill,
+                step=self.step_no,
+            )
+        self._flight_note(
+            "router_break", replica=h.idx, reason=reason, killed=kill,
+        )
+        victims = list(h.inflight.values())
+        h.inflight.clear()
+        for rr in victims:
+            if rr.attempt is not None and rr.attempt.outcome:
+                # Typed-terminal before the break surfaced it (e.g.
+                # reaped as expired in the very step that then raised):
+                # honor the engine's outcome instead of regenerating.
+                self._finalize(rr, rr.attempt.outcome, done)
+                continue
+            if not h.dead and rr.attempt is not None:
+                h.engine.cancel(rr.attempt.rid)
+            rr.attempt = None
+            rr.replica = None
+            self._requeue(rr, done, f"replica {h.idx}: {reason}")
+
+    def _open_to_half_open(self) -> None:
+        for h in self.handles:
+            if h.dead or h.state != OPEN:
+                continue
+            if self.step_no - h.opened_at >= self.rcfg.probe_after_steps:
+                h.state = HALF_OPEN
+                self.stats.probes += 1
+                log.warning(
+                    "replica %d breaker HALF_OPEN: probing", h.idx
+                )
+                if self._tracer.enabled:
+                    self._tracer.instant(
+                        "probe", replica=h.idx, step=self.step_no
+                    )
+                self._flight_note("router_probe", replica=h.idx)
+
+    def _requeue(
+        self, rr: RouterRequest, done: list[RouterRequest], why: str
+    ) -> None:
+        """Failover: re-queue ``rr`` on the survivors under the retry
+        budget with jittered exponential step-count backoff — or shed it,
+        typed, when the budget (or the fleet) is exhausted."""
+        survivors = [x for x in self.handles if not x.dead]
+        if rr.retries >= self.rcfg.retry_budget or not survivors:
+            self._shed(
+                rr,
+                f"{why}; retries={rr.retries}/{self.rcfg.retry_budget}, "
+                f"survivors={len(survivors)}",
+                done,
+            )
+            return
+        rr.retries += 1
+        self.stats.retries += 1
+        delay = self.rcfg.retry_backoff_steps * (1 << (rr.retries - 1))
+        if self.rcfg.retry_backoff_jitter:
+            delay += self._rng.randint(0, self.rcfg.retry_backoff_jitter)
+        rr.due_step = self.step_no + delay
+        log.warning(
+            "request %d failing over (%s): retry %d/%d after %d steps",
+            rr.rid, why, rr.retries, self.rcfg.retry_budget, delay,
+        )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "retry", rid=rr.rid, attempt=rr.retries,
+                backoff_steps=delay, reason=why, step=self.step_no,
+            )
+        self._flight_note(
+            "router_retry", rid=rr.rid, attempt=rr.retries, reason=why,
+        )
+        self.waiting.append(rr)
+
+    def _shed(
+        self, rr: RouterRequest, why: str, done: list[RouterRequest]
+    ) -> None:
+        log.warning("router shedding request %d: %s", rr.rid, why)
+        self.stats.router_shed += 1
+        self._finalize(rr, "shed", done)
+
+    def _finalize(
+        self, rr: RouterRequest, outcome: str, done: list[RouterRequest]
+    ) -> None:
+        """Stamp the one typed outcome and surface the request. The
+        lifecycle instant carries the ``retried`` tag — how many failover
+        attempts this request consumed on its way to the outcome."""
+        assert not rr.done, (rr.rid, rr.outcome, outcome)
+        rr.outcome = outcome
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "outcome", rid=rr.rid, outcome=outcome,
+                retried=rr.retries, tokens=len(rr.generated),
+                step=self.step_no,
+            )
+        done.append(rr)
+
+    def _finish(
+        self,
+        h: ReplicaHandle,
+        rr: RouterRequest,
+        er: Request,
+        done: list[RouterRequest],
+    ) -> None:
+        """An engine attempt reached its typed outcome. Engine-level
+        sheds of an admitted-then-evicted request re-enter the failover
+        path (another replica may have room); everything else is final.
+        A HALF_OPEN probe's outcome decides the breaker: completed ->
+        CLOSED; replica-fault outcomes (error:*, shed) -> re-OPEN;
+        client-driven terminals (cancelled, expired) are NEUTRAL — they
+        say nothing about replica health, so the breaker stays HALF_OPEN
+        and the next eligible request becomes the new probe."""
+        was_probe = h.probe_rid == er.rid
+        if was_probe:
+            h.probe_rid = None
+        if er.outcome == "shed" and not self.draining:
+            rr.attempt = None
+            rr.replica = None
+            self._requeue(rr, done, f"replica {h.idx} shed")
+        else:
+            rr.attempt = er
+            self._finalize(rr, er.outcome, done)
+        if was_probe and h.state == HALF_OPEN:
+            if er.outcome == "completed":
+                h.state = CLOSED
+                h.unhealthy = 0
+                self.stats.recoveries += 1
+                log.warning(
+                    "replica %d breaker CLOSED (probe completed)", h.idx
+                )
+                if self._tracer.enabled:
+                    self._tracer.instant(
+                        "recover", replica=h.idx, step=self.step_no
+                    )
+                self._flight_note("router_recover", replica=h.idx)
+            elif er.outcome not in ("cancelled", "expired"):
+                h.state = OPEN
+                h.opened_at = self.step_no
+
+    # -- placement ---------------------------------------------------------
+
+    def _load_key(self, h: ReplicaHandle) -> tuple:
+        """Load order for placement tiebreaks, read from the replica's
+        metrics registry (never ad-hoc counters): queue depth + active
+        slots first, then pool occupancy, then the current window's
+        device-seconds-per-slot-step (the per-class ITL proxy — a replica
+        grinding through slow verify windows ranks below an idle one at
+        equal occupancy). Replica index last for determinism."""
+        g = h.engine.registry.snapshot(sections=("engine", "pool"))
+        queued = g.get("engine.waiting", 0) + g.get("engine.active", 0)
+        occupancy = g.get("pool.occupancy", 0.0)
+        itl = g.get("engine.device_s", 0.0) / max(
+            g.get("engine.slot_steps", 0), 1
+        )
+        return (queued, occupancy, itl, h.idx)
+
+    def _place(self, rr: RouterRequest):
+        """(handle, affinity, match_tokens) for the best placement right
+        now, or None when no replica is routable. Longest radix match >=
+        affinity_min_tokens wins (load breaks ties among equal matches);
+        otherwise least-loaded."""
+        cands = [h for h in self.handles if h.routable]
+        if not cands:
+            return None
+        matches = {
+            h.idx: h.engine.prefix_match_tokens(rr.prompt) for h in cands
+        }
+        best = max(matches.values())
+        affinity = best >= self.rcfg.affinity_min_tokens
+        pool = (
+            [h for h in cands if matches[h.idx] == best]
+            if affinity else cands
+        )
+        h = min(pool, key=self._load_key)
+        return h, affinity, matches[h.idx]
+
+    def _try_place(
+        self,
+        rr: RouterRequest,
+        done: list[RouterRequest],
+        raise_errors: bool = False,
+    ) -> bool:
+        """Place ``rr`` on the best routable replica; returns True when
+        it was admitted somewhere (or reached a terminal outcome trying).
+        ``raise_errors`` propagates engine validation errors (the
+        synchronous submit path); the queue path converts them to a typed
+        error outcome instead of killing the step loop."""
+        picked = self._place(rr)
+        if picked is None:
+            return False
+        h, affinity, match = picked
+        deadline_s = None
+        if rr.deadline is not None:
+            deadline_s = rr.deadline - time.monotonic()
+            if deadline_s <= 0:
+                self._finalize(rr, "expired", done)
+                return True
+        try:
+            er = h.engine.submit_request(
+                rr.prompt, rr.max_new_tokens,
+                temperature=rr.temperature, top_k=rr.top_k,
+                top_p=rr.top_p, deadline_s=deadline_s,
+                priority=rr.priority,
+            )
+        except ValueError:
+            if raise_errors:
+                raise
+            self._finalize(rr, "error:submit", done)
+            return True
+        if er.done:
+            # Shed on arrival (bounded queue / replica draining): spend a
+            # retry on the rest of the fleet instead of giving up.
+            self._requeue(rr, done, f"replica {h.idx} shed on admit")
+            return True
+        rr.attempt = er
+        rr.replica = h.idx
+        rr.placed = True
+        h.inflight[er.rid] = rr
+        self.stats.routed += 1
+        if affinity:
+            self.stats.affinity_routes += 1
+        else:
+            self.stats.cold_routes += 1
+        probe = h.state == HALF_OPEN
+        if probe:
+            h.probe_rid = er.rid
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "route", rid=rr.rid, replica=h.idx, match_tokens=match,
+                affinity=affinity, probe=probe, retried=rr.retries,
+                step=self.step_no,
+            )
+        return True
+
+    def _dispatch_queue(self, done: list[RouterRequest]) -> None:
+        """Place every due queued request (backoff gates failover
+        re-placements); requests that cannot be placed wait — unless the
+        whole fleet is dead, which sheds them typed."""
+        if not self.waiting:
+            return
+        still: deque[RouterRequest] = deque()
+        all_dead = all(h.dead for h in self.handles)
+        now = time.monotonic()
+        while self.waiting:
+            rr = self.waiting.popleft()
+            if all_dead:
+                self._shed(rr, "all replicas down", done)
+                continue
+            if rr.deadline is not None and now >= rr.deadline:
+                # Router-queued requests expire at step boundaries too —
+                # waiting out a backoff (or an all-open fleet) does not
+                # suspend the SLO clock.
+                self._finalize(rr, "expired", done)
+                continue
+            if rr.due_step > self.step_no:
+                still.append(rr)
+                continue
+            if not self._try_place(rr, done):
+                still.append(rr)
+        self.waiting = still

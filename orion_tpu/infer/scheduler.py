@@ -1,0 +1,139 @@
+"""Scheduler face of the serving engine (ISSUE 12 tentpole split).
+
+The engine used to be one 2.7k-line class mixing two concerns: the
+*scheduler face* — what a multi-replica front-end talks to: the request
+lifecycle (typed outcomes), the admission queue with its shed/deadline
+policy, and the radix prefix index as a placement signal — and the
+*executor* — the jitted dispatch programs, the KV pool and the
+degradation ladder (infer/executor.py). This module owns the scheduler
+half: the ``Request`` dataclass and the ``AdmissionQueue`` policy object
+the engine delegates its queue decisions to. ``infer/router.py`` builds
+on exactly this face: a replica is "somewhere requests can be admitted,
+with typed outcomes and registry gauges", nothing more.
+
+Behavior contract: everything here is a verbatim relocation of engine
+policy — single-replica serving compiles byte-identical programs and
+produces byte-identical greedy streams (pinned by tests/test_router.py's
+pass-through equivalence case).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    generated: list[int] = field(default_factory=list)
+    # Per-request sampling overrides; None = inference.* config defaults.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    # SLO class (higher = more important): admission and page-pressure
+    # preemption prefer high-priority requests; overload shedding evicts
+    # the lowest class first.
+    priority: int = 0
+    # Absolute time.monotonic() deadline (None = none). Expired requests
+    # are reaped at step boundaries with a typed "expired" outcome.
+    deadline: Optional[float] = None
+    # Typed terminal outcome: "" while live, then exactly one of
+    # "completed" | "expired" | "cancelled" | "shed" | "error:<kind>".
+    # Every submitted request surfaces from step() with an outcome — no
+    # silent drops.
+    outcome: str = ""
+    # scheduler state
+    slot: Optional[int] = None
+    pages: list[int] = field(default_factory=list)
+    done: bool = False
+    admit_seq: int = -1   # admission order; preemption evicts the youngest
+    freed_until: int = 0  # logical pages below this are freed (SWA rolling)
+    # Prefix-cache state: the first n_prefix entries of ``pages`` are
+    # SHARED (refcounted, immutable) cache pages; prefix_node pins their
+    # radix-tree path against eviction until release.
+    n_prefix: int = 0
+    prefix_node: Optional[Any] = None
+    # Chunked-prefill cursor (inference.chunked_prefill): context tokens
+    # whose KV is already in the pool (cached prefix + completed chunks,
+    # always page-aligned until the final chunk). While prefill_pending,
+    # the slot rides mixed steps as a prompt-chunk row, never a decode row.
+    prefill_done: int = 0
+    prefill_pending: bool = False
+
+    @property
+    def context(self) -> list[int]:
+        """Tokens whose KV must be in cache: prompt + everything generated.
+        This is what (re-)prefill runs on, so a preempted request resumes
+        exactly where it left off."""
+        return self.prompt + self.generated
+
+    @property
+    def active(self) -> bool:
+        return self.slot is not None and not self.done
+
+
+def in_flight(req: Request) -> bool:
+    """A queued request that has RUN: admitted at least once and not
+    since un-claimed (admit_seq >= 0 — preemption and fault unwinds
+    keep it), or carrying generated tokens from a previous residency
+    (survives even an admission pool-fault deferral, which resets
+    admit_seq). In-flight requests are exempt from overload shedding
+    and are finished — not shed — by drain()."""
+    return req.admit_seq >= 0 or bool(req.generated)
+
+
+class AdmissionQueue(deque):
+    """The engine's wait queue plus its admission-side policy.
+
+    A plain deque (every existing queue operation — appendleft, index
+    deletion, iteration — keeps working) carrying the two policy
+    decisions the scheduler face owns:
+
+      - ``shed_victim``: which request an over-limit submit sheds;
+      - ``sweep_expired``: the step-boundary deadline sweep over
+        still-waiting requests.
+
+    Both are verbatim relocations of the engine's inline logic.
+    """
+
+    def shed_victim(self, incoming: Request) -> Request:
+        """The least defensible overload-shed candidate among the queued
+        never-run requests plus ``incoming``: lowest priority first, then
+        the nearest (most infeasible) deadline, then the newest arrival —
+        which may be the incoming request itself. In-flight requests
+        (see ``in_flight``) are never victims: "shed" means never
+        admitted (RobustnessStats contract)."""
+        return min(
+            [r for r in self if not in_flight(r)] + [incoming],
+            key=lambda r: (
+                r.priority,
+                r.deadline if r.deadline is not None else float("inf"),
+                -r.rid,
+            ),
+        )
+
+    def sweep_expired(self, now: Optional[float] = None) -> list[Request]:
+        """Remove and return every queued request whose deadline has
+        passed (callers mark them "expired" — the typed outcome stays
+        with the engine, which owns the stats and the finished list)."""
+        if now is None:
+            now = time.monotonic()
+        if not any(
+            r.deadline is not None and now >= r.deadline for r in self
+        ):
+            return []
+        expired: list[Request] = []
+        keep: list[Request] = []
+        for r in self:
+            if r.deadline is not None and now >= r.deadline:
+                expired.append(r)
+            else:
+                keep.append(r)
+        self.clear()
+        self.extend(keep)
+        return expired

@@ -193,9 +193,11 @@ class RobustnessStats:
     exactly one typed outcome — there are no silent drops.
 
     Fault episodes: ``dispatch_faults`` counts dispatch attempts that
-    raised (injected or real), ``dispatch_fallbacks`` the retries that ran
-    on the XLA reference path, ``failed_steps`` engine steps abandoned
-    after every path failed (the engine continues; state untouched),
+    raised (injected or real), ``dispatch_retries`` the XLA-fallback
+    retry attempts started (``inference.dispatch_retries`` per episode),
+    ``dispatch_fallbacks`` the retries that SUCCEEDED on the XLA
+    reference path, ``failed_steps`` engine steps abandoned after every
+    path failed (the engine continues; state untouched),
     ``stalled_steps`` steps the watchdog flagged as stalled, and
     ``pool_faults`` page-allocation failures absorbed at admit/grow.
     """
@@ -205,6 +207,7 @@ class RobustnessStats:
     cancelled: int = 0
     quarantined: int = 0
     dispatch_faults: int = 0
+    dispatch_retries: int = 0
     dispatch_fallbacks: int = 0
     failed_steps: int = 0
     stalled_steps: int = 0
@@ -218,10 +221,55 @@ class RobustnessStats:
             "cancelled_requests": self.cancelled,
             "quarantined_requests": self.quarantined,
             "dispatch_faults": self.dispatch_faults,
+            "dispatch_retries": self.dispatch_retries,
             "dispatch_fallbacks": self.dispatch_fallbacks,
             "failed_steps": self.failed_steps,
             "stalled_steps": self.stalled_steps,
             "pool_faults": self.pool_faults,
+        }
+
+
+@dataclass
+class RouterStats:
+    """Multi-replica router counters (infer/router.py; ISSUE 12), the
+    router-level twin of ``RobustnessStats`` — drained through
+    ``Router.reset_timing`` and registered as the ``router`` section of
+    the router's metrics registry.
+
+    Placement: ``routed`` counts engine placements (including failover
+    re-placements and half-open probes), split into ``affinity_routes``
+    (longest radix match >= router.affinity_min_tokens pinned the
+    replica) and ``cold_routes`` (no usable match — least-loaded replica
+    by registry gauges). Failover: ``retries`` counts re-queues of
+    in-flight requests off a dead/broken replica, ``router_shed``
+    requests the ROUTER shed (retry budget exhausted, or no survivors) —
+    engine-level sheds stay in the engine's own stats. Breaker:
+    ``breaks`` OPEN trips (health sweep or a step() escalation),
+    ``kills`` the replica_kill subset, ``probes`` OPEN->HALF_OPEN
+    transitions, ``recoveries`` probes that closed the breaker.
+    """
+
+    routed: int = 0
+    affinity_routes: int = 0
+    cold_routes: int = 0
+    retries: int = 0
+    router_shed: int = 0
+    breaks: int = 0
+    kills: int = 0
+    probes: int = 0
+    recoveries: int = 0
+
+    def as_timing(self) -> dict[str, float]:
+        return {
+            "routed": self.routed,
+            "affinity_routes": self.affinity_routes,
+            "cold_routes": self.cold_routes,
+            "retries": self.retries,
+            "router_shed": self.router_shed,
+            "breaks": self.breaks,
+            "kills": self.kills,
+            "probes": self.probes,
+            "recoveries": self.recoveries,
         }
 
 

@@ -322,12 +322,28 @@ class FaultSpec:
         rename commits anyway, modeling post-rename data loss; restore
         must checksum-detect it, quarantine, and fall back.
 
+    Replica-scoped kinds (ISSUE 12; the multi-replica Router consumes
+    these with ``step`` = the ROUTER step number, and ``replica``
+    selecting the victim):
+      - "replica_kill":  the replica's process dies — the router never
+        steps that engine again; its in-flight AND engine-queued requests
+        fail over to survivors under the retry budget. Modeled as sudden
+        death: nothing on the dead replica is cancelled or drained.
+      - "replica_stall": forward a "stall" spec (``stall_s``) into the
+        replica engine's own injector at its next step — the engine
+        watchdog flags it and the router's health sweep sees the stalled
+        step, exercising the soft-break path end to end.
+      - "replica_poison": forward a "nan" spec into the replica engine's
+        injector — with inference.nan_guard the quarantine storm shows up
+        in the router's health sweep as ``quarantined`` deltas.
+
     ``step`` is the engine step number (``InferenceEngine.step_no``) to fire
-    at; ``path`` optionally restricts dispatch/stall faults to one coarse
-    dispatch path ("prefill" | "decode" | "verify" | "mixed" |
-    "mixed_verify" | "train"); ``rid`` optionally selects the nan victim
-    (default: the oldest active request). ``count`` fires the spec that
-    many times.
+    at — or the router step for replica-scoped kinds; ``path`` optionally
+    restricts dispatch/stall faults to one coarse dispatch path
+    ("prefill" | "decode" | "verify" | "mixed" | "mixed_verify" |
+    "train"); ``rid`` optionally selects the nan victim (default: the
+    oldest active request); ``replica`` selects the replica-scoped
+    victim. ``count`` fires the spec that many times.
     """
 
     kind: str
@@ -336,14 +352,23 @@ class FaultSpec:
     rid: Optional[int] = None
     stall_s: float = 0.0
     count: int = 1
+    replica: Optional[int] = None
+
+    REPLICA_KINDS = ("replica_kill", "replica_stall", "replica_poison")
 
     def __post_init__(self):
         if self.kind not in (
-            "dispatch", "nan", "pool", "stall", "partial_write"
-        ):
+            "dispatch", "nan", "pool", "stall", "partial_write",
+        ) + self.REPLICA_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.count < 1:
             raise ValueError(f"count must be >= 1, got {self.count}")
+        if self.kind in self.REPLICA_KINDS and (
+            self.replica is None or self.replica < 0
+        ):
+            raise ValueError(
+                f"{self.kind} needs replica=<index>, got {self.replica}"
+            )
 
 
 @dataclass

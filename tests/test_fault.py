@@ -737,6 +737,103 @@ def test_fault_config_validation():
         eng.submit([1, 2], 4, deadline_s=-1.0)
 
 
+def test_dispatch_retry_loop_absorbs_flaky_fallback(tiny):
+    """inference.dispatch_retries > 1 (ISSUE 12 satellite): the fallback
+    retry LOOP absorbs a transiently-failing XLA fallback — here the
+    first two fallback attempts raise — with the attempts counted in
+    RobustnessStats and the output byte-identical."""
+    params, _ = tiny
+    pall = ["model.kernels=pallas_interpret", "inference.dispatch_retries=3"]
+    ref = _engine(params, ["model.kernels=pallas_interpret"]).generate(MIX, 8)
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="decode")])
+    eng = _engine(params, pall, inj=inj)
+    real = eng._executor.fallback_program
+    flaky = {"left": 2}
+
+    def failing_twice(name):
+        fb = real(name)
+        if fb is None:
+            return None
+
+        def wrapped(*a, **k):
+            if flaky["left"] > 0:
+                flaky["left"] -= 1
+                raise RuntimeError("transient fallback fault")
+            return fb(*a, **k)
+
+        return wrapped
+
+    eng._executor.fallback_program = failing_twice
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    # 1 primary fault + 2 failed fallback attempts; the 3rd succeeds.
+    assert t["dispatch_faults"] == 3 and t["dispatch_retries"] == 3
+    assert t["dispatch_fallbacks"] == 1 and t["failed_steps"] == 0
+    eng.assert_page_accounting()
+
+
+def test_dispatch_retries_zero_disables_fallback(tiny):
+    """dispatch_retries=0 turns the episode into a contained failed step
+    even with dispatch_fallback=true — the 0-attempt loop is the
+    fallback-off path."""
+    params, _ = tiny
+    pall = ["model.kernels=pallas_interpret"]
+    ref = _engine(params, pall).generate(MIX, 8)
+    inj = FaultInjector([FaultSpec("dispatch", step=2, path="decode")])
+    eng = _engine(
+        params, pall + ["inference.dispatch_retries=0"], inj=inj
+    )
+    assert eng.generate(MIX, 8) == ref
+    t = eng.reset_timing()
+    assert t["dispatch_fallbacks"] == 0 and t["failed_steps"] == 1
+    assert t["dispatch_retries"] == 0
+    with pytest.raises(ValueError, match="dispatch_retries"):
+        get_config("tiny-llama", INFER + ["inference.dispatch_retries=-1"])
+
+
+def test_submit_after_drain_and_close_sheds_typed(tiny):
+    """Engine lifecycle edges the router leans on (ISSUE 12 satellite):
+    submit() after drain() AND after close() yields a typed "shed"
+    outcome that surfaces from the next step() — never a raise, never a
+    request queued for a step loop that will not run."""
+    params, ref = tiny
+    eng = _engine(params)
+    assert eng.generate(MIX[:2], 8) == ref[:2]
+    eng.drain()
+    late = eng.submit_request([1, 2, 3], 4)
+    assert late.done and late.outcome == "shed"
+    assert late in eng.step()           # surfaces exactly once
+    eng.close()
+    later = eng.submit_request([4, 5, 6], 4)
+    assert later.done and later.outcome == "shed"
+    assert later in eng.step()
+    t = eng.reset_timing()
+    assert t["shed_requests"] == 2
+    eng.assert_page_accounting()
+
+
+def test_drain_idempotent_under_concurrent_cancel(tiny):
+    """drain() composes with cancel(): cancelling an active request just
+    before/after the drain never double-releases or hangs; a second
+    drain() is a no-op; the pool stays exactly accounted."""
+    params, ref = tiny
+    eng = _engine(params)
+    reqs = [eng.submit_request(p, 8) for p in MIX[:3]]
+    eng.step()                          # admit + first tokens
+    assert eng.cancel(reqs[0].rid)
+    drained = eng.drain()
+    assert {r.rid for r in drained} == {r.rid for r in reqs}
+    assert reqs[0].outcome == "cancelled"
+    assert reqs[1].outcome == "completed"
+    assert reqs[1].generated == ref[1]
+    # Concurrent-cancel edge: cancel of an already-drained rid is a
+    # clean no-op, and drain() again returns nothing.
+    assert not eng.cancel(reqs[0].rid)
+    assert eng.drain() == []
+    eng.assert_page_accounting()
+    eng.close()
+
+
 def test_overload_bench_smoke():
     """tools/serving_latency_bench.py --overload --smoke (tier-1 wiring):
     at 2x-capacity offered load every miss is a typed shed/expiry (no

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Multi-replica router bench: failover chaos + recovery curve (ISSUE 12).
+
+Scenario: N local engine replicas behind ``infer.Router`` serve a greedy
+workload (a shared warm prefix on part of it, so prefix-affinity placement
+is exercised, not just round-robin). Two runs:
+
+  - **baseline**: no chaos — measures accepted-token throughput per router
+    step, TTFT/ITL percentiles, and the placement split
+    (affinity vs cold, off the registry gauges).
+  - **chaos**: one replica is KILLED mid-decode (FaultSpec
+    "replica_kill" through the real router fault path). The pin: every
+    in-flight request on the dead replica ends in exactly ONE typed
+    outcome (retried-then-completed or shed — zero duplicates, zero
+    silent drops), completed greedy streams are byte-identical to an
+    uninterrupted single-engine run, and accepted throughput recovers to
+    >= 2/3 of baseline within a bounded number of router steps.
+
+Reported per mode (one JSON line each): outcome counts (aggregate and
+per-replica via ``obs.bench_metrics_block``), throughput/recovery, router
+decision counters (routed/affinity/retries/breaks), TTFT/ITL. A final
+JSON verdict line carries the chaos-pin booleans; ``--smoke`` (tier-1
+wiring, tests/test_router.py) asserts them.
+
+    python tools/router_bench.py            # on-chip numbers
+    python tools/router_bench.py --smoke    # tiny CPU logic check
+"""
+import sys as _sys, pathlib as _pathlib
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+
+def _workload(n_requests: int, warm_prefix: list, max_new: int):
+    """Greedy prompts: half share ``warm_prefix`` (page-aligned, so the
+    radix tree can serve it once donated), half are cold and distinct."""
+    prompts = []
+    for i in range(n_requests):
+        if i % 2 == 0:
+            prompts.append(warm_prefix + [50 + i, 51 + i, 52 + i])
+        else:
+            prompts.append([5 + 7 * i, 3 + i, 9, 250 - i, 17, 2 + i])
+    return prompts
+
+
+def _run(cfg, params, prompts, max_new, ref, kill_step=None,
+         recovery_window=4, prime=()):
+    """Serve the workload through a fresh router; returns the measurement
+    dict (+ per-request records for the verdict).
+
+    ``prime``: prompts served to completion BEFORE the measured window —
+    they donate their prefixes to whichever replicas served them, so the
+    measured run's warm-prefix requests exercise affinity placement the
+    way steady-state traffic would (a cold fleet has no radix trees to
+    be affine to)."""
+    from orion_tpu.infer import Router
+    from orion_tpu.metrics import LatencyStats
+    from orion_tpu.obs import bench_metrics_block
+    from orion_tpu.runtime.fault import FaultInjector, FaultSpec
+
+    inj = None
+    if kill_step is not None:
+        inj = FaultInjector(
+            [FaultSpec("replica_kill", step=kill_step, replica=0)]
+        )
+    router = Router(cfg, params, fault_injector=inj)
+    if prime:
+        for pr in prime:
+            router.submit_request(pr, 2)
+        while router.has_work():
+            router.step()
+        router.reset_timing()           # placement counters start clean
+        router.step_no = 0              # kill_step counts measured steps
+    t0 = time.perf_counter()
+    reqs = [router.submit_request(p, max_new) for p in prompts]
+    submit_t = {rr.rid: time.perf_counter() for rr in reqs}
+    seen = {rr.rid: 0 for rr in reqs}
+    first_t, last_t = {}, {}
+    itl = LatencyStats()
+    finished = []                 # every (rid, outcome) surfaced by step()
+    tokens_per_step = []          # accepted tokens per router step
+    killed_inflight = None        # rids in flight on replica 0 at the kill
+    while router.has_work():
+        if (
+            kill_step is not None and killed_inflight is None
+            and router.step_no == kill_step
+        ):
+            killed_inflight = [
+                rr.rid
+                for rr in router.handles[0].inflight.values()
+            ]
+        done = router.step()
+        now = time.perf_counter()
+        accepted = 0
+        for rr in reqs:
+            n = len(rr.generated)
+            if n > seen[rr.rid]:
+                accepted += n - seen[rr.rid]
+                if rr.rid not in first_t:
+                    first_t[rr.rid] = now
+                elif rr.rid in last_t:
+                    itl.record(now - last_t[rr.rid])
+                    for _ in range(n - seen[rr.rid] - 1):
+                        itl.record(0.0)
+                last_t[rr.rid] = now
+                seen[rr.rid] = n
+        tokens_per_step.append(accepted)
+        finished.extend((rr.rid, rr.outcome) for rr in done)
+    wall_s = time.perf_counter() - t0
+
+    # Throughput + recovery: the busy window is every step before the
+    # tail drain (trailing zero-accept steps as the last requests finish).
+    busy = tokens_per_step
+    while busy and busy[-1] == 0:
+        busy = busy[:-1]
+    rate = sum(busy) / len(busy) if busy else 0.0
+    recovery_steps = None
+    if kill_step is not None and ref["rate"] > 0:
+        target = (2.0 / 3.0) * ref["rate"]
+        w = recovery_window
+        for s in range(kill_step, len(busy) - w + 1):
+            if sum(busy[s:s + w]) / w >= target:
+                recovery_steps = s - kill_step
+                break
+
+    outcomes: dict[str, int] = {}
+    for rr in reqs:
+        outcomes[rr.outcome or "MISSING"] = (
+            outcomes.get(rr.outcome or "MISSING", 0) + 1
+        )
+    per_replica = []
+    for h in router.handles:
+        t = h.engine.reset_timing()
+        per_replica.append({
+            "replica": h.idx,
+            "dead": h.dead,
+            "state": h.state,
+            "metrics": bench_metrics_block(h.engine, timing=t),
+        })
+    out = {
+        "mode": "chaos" if kill_step is not None else "baseline",
+        "replicas": cfg.router.replicas,
+        "requests": len(reqs),
+        "wall_s": round(wall_s, 3),
+        "router_steps": len(tokens_per_step),
+        "accepted_tokens": sum(tokens_per_step),
+        "tokens_per_step": round(rate, 3),
+        "kill_step": kill_step,
+        "recovery_steps": recovery_steps,
+        "outcomes": outcomes,
+        "router": router.reset_timing(),
+        "ttft": {
+            rid: round(first_t[rid] - submit_t[rid], 4)
+            for rid in sorted(first_t)
+        },
+        "itl": {k: round(v, 4) for k, v in itl.summary().items()},
+        "per_replica": per_replica,
+    }
+    records = {
+        "reqs": reqs,
+        "finished": finished,
+        "killed_inflight": killed_inflight or [],
+    }
+    router.close()
+    return out, records
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU config; assert the chaos pin")
+    p.add_argument("--preset", default="tiny-llama")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--requests", type=int, default=10)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--kill-step", type=int, default=4,
+                   help="router step at which replica 0 is killed "
+                        "(after prefill, mid-decode)")
+    p.add_argument("--recovery-bound", type=int, default=16,
+                   help="max router steps after the kill for throughput "
+                        "to recover to 2/3 of baseline")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    from orion_tpu.config import get_config
+    from orion_tpu.infer import InferenceEngine
+    from orion_tpu.models import init_params
+
+    overrides = [
+        "inference.max_seq_len=256",
+        "inference.page_size=16",
+        "inference.num_pages=64",
+        "inference.max_batch_size=4",
+        "inference.prefill_chunk=16",
+        "inference.decode_window=1",
+        "inference.prefix_cache=true",
+        f"router.replicas={args.replicas}",
+        "router.affinity_min_tokens=16",
+    ]
+    cfg = get_config(args.preset, overrides)
+    params = init_params(cfg.model, jax.random.key(0))
+    warm = list(range(1, 17))       # one full page: the shared prefix
+    prompts = _workload(args.requests, warm, args.max_new)
+
+    # Uninterrupted single-engine reference: the byte-identity pin for
+    # every completed greedy stream, chaos or not.
+    ref_streams = InferenceEngine(cfg, params).generate(
+        prompts, args.max_new
+    )
+
+    prime = [warm + [40], warm + [41]]
+    base, base_rec = _run(cfg, params, prompts, args.max_new,
+                          {"rate": 0.0}, prime=prime)
+    print(json.dumps(base), flush=True)
+    chaos, chaos_rec = _run(
+        cfg, params, prompts, args.max_new,
+        {"rate": base["tokens_per_step"]}, kill_step=args.kill_step,
+        prime=prime,
+    )
+    print(json.dumps(chaos), flush=True)
+
+    def check(run, rec):
+        reqs = rec["reqs"]
+        rid_counts: dict[int, int] = {}
+        for rid, _ in rec["finished"]:
+            rid_counts[rid] = rid_counts.get(rid, 0) + 1
+        all_typed = all(rr.outcome for rr in reqs)
+        no_duplicates = all(c == 1 for c in rid_counts.values())
+        no_silent_drops = sorted(rid_counts) == sorted(
+            rr.rid for rr in reqs
+        )
+        byte_identical = all(
+            list(rr.generated) == ref_streams[i]
+            for i, rr in enumerate(reqs) if rr.outcome == "completed"
+        )
+        return all_typed, no_duplicates, no_silent_drops, byte_identical
+
+    b_typed, b_dup, b_drop, b_bytes = check(base, base_rec)
+    c_typed, c_dup, c_drop, c_bytes = check(chaos, chaos_rec)
+    by_rid = {rr.rid: rr for rr in chaos_rec["reqs"]}
+    killed_resolved = all(
+        by_rid[rid].outcome in ("completed", "shed")
+        for rid in chaos_rec["killed_inflight"]
+    )
+    recovered = (
+        chaos["recovery_steps"] is not None
+        and chaos["recovery_steps"] <= args.recovery_bound
+    )
+    verdict = {
+        "verdict": True,
+        "baseline_all_typed": b_typed,
+        "baseline_byte_identical": b_bytes,
+        "chaos_all_typed": c_typed,
+        "chaos_no_duplicates": c_dup and b_dup,
+        "chaos_no_silent_drops": c_drop and b_drop,
+        "chaos_survivor_streams_byte_identical": c_bytes,
+        "chaos_killed_inflight": len(chaos_rec["killed_inflight"]),
+        "chaos_killed_resolved_typed": killed_resolved,
+        "chaos_retries": chaos["router"]["retries"],
+        "affinity_used": base["router"]["affinity_routes"] > 0,
+        "throughput_recovered_to_two_thirds": recovered,
+        "recovery_steps": chaos["recovery_steps"],
+        "recovery_bound": args.recovery_bound,
+    }
+    verdict["verdict"] = all(
+        v for k, v in verdict.items()
+        if isinstance(v, bool) and k != "verdict"
+    )
+    print(json.dumps(verdict), flush=True)
+    if args.smoke and not verdict["verdict"]:
+        print("SMOKE FAIL", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

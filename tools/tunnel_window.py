@@ -61,6 +61,12 @@ QUEUE = [
     # bitwise) ride tpu_parity above.
     ("spec_decode",
      [sys.executable, str(ROOT / "tools/spec_decode_bench.py")], 2700),
+    # Multi-replica router chaos bench (ISSUE 12): N on-chip replicas,
+    # kill-one-mid-run failover — the recovery curve (accepted tokens/
+    # step, p99 TTFT through the failover window) and the typed-outcome
+    # pin, measured on real hardware (the --smoke twin rides tier-1).
+    ("router",
+     [sys.executable, str(ROOT / "tools/router_bench.py")], 1800),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
