@@ -70,6 +70,18 @@ TRAIN_PROBES: dict[str, tuple[list, int]] = {
     "zero1_scan_group4_names": (
         ["parallel.dp=4", "train.zero1=true", "model.scan_group=4",
          "train.remat=names"], 780),
+    # 1F1B pipeline probe (ISSUE 13): pp=2 needs a >=2-chip window; the
+    # 1-chip dev box records a fast device-count config error exactly
+    # like the zero1 probes. The hand-written VJP bounds the in-flight
+    # activation stash by the stage count (PERF.md "Pipeline schedules"
+    # 1F1B rows), so this probe is the on-chip memory/occupancy twin of
+    # tools/pp_bubble_bench.py's fake-mesh table.
+    "pp_1f1b": (
+        ["parallel.pp=2", "parallel.pp_microbatches=4",
+         "parallel.pp_schedule=1f1b"], 780),
+    "pp_1f1b_zero1": (
+        ["parallel.pp=2", "parallel.dp=2", "parallel.pp_microbatches=4",
+         "parallel.pp_schedule=1f1b", "train.zero1=true"], 780),
 }
 PROBE_STEADY_S = 240   # post-compile step allowance per probe
 PROBE_STEPS = 12       # compile + a few steady-state steps

@@ -36,6 +36,13 @@ if not hasattr(_jax, "shard_map"):
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
         )
 
+    # Marker consumed by parallel/pipeline.py: the old runtime's SPMD
+    # partitioner cannot lower a partial-auto (axis_names-subset) region
+    # that uses axis_index / ppermute, or the transposed while loop
+    # jax.grad makes of a scanned one — the pipeline switches to its
+    # compat formulation (stage-id inputs, one-hot reduce-scatter ring
+    # hops, unrolled tick loops) when it sees this.
+    _shard_map_compat._orion_compat = True
     _jax.shard_map = _shard_map_compat
 
 if not hasattr(_jax.lax, "axis_size"):

@@ -119,12 +119,13 @@ class ModelConfig:
 
     # Pipeline parallelism: when pipeline_axis names a mesh axis of size > 1
     # (the trainer sets this from ParallelConfig.pp), the layer stack runs as
-    # a GPipe pipeline with this many microbatches. "interleaved" runs the
-    # virtual-stage schedule (pp_virtual_stages chunks per device, M <= pp)
-    # — see parallel/pipeline.py for the bubble math.
+    # a pipeline with this many microbatches. "interleaved" runs the
+    # virtual-stage schedule (pp_virtual_stages chunks per device, M <= pp);
+    # "1f1b" the hand-written-VJP schedule whose per-stage activation stash
+    # is bounded by the stage count — see parallel/pipeline.py.
     pipeline_axis: Optional[str] = None
     pp_microbatches: int = 1
-    pp_schedule: str = "gpipe"        # "gpipe" | "interleaved"
+    pp_schedule: str = "gpipe"        # "gpipe" | "interleaved" | "1f1b"
     pp_virtual_stages: int = 1
 
     # Gradient checkpointing policy for the layer scan:
@@ -345,11 +346,34 @@ class ParallelConfig:
     # Pipeline microbatches (pp > 1). Must divide the per-step batch.
     pp_microbatches: int = 1
     # Pipeline schedule: "gpipe" | "interleaved" (virtual stages; bubble
-    # amortized by pp_virtual_stages instead of microbatch count).
+    # amortized by pp_virtual_stages instead of microbatch count) |
+    # "1f1b" (hand-written pipeline VJP: per-stage activation stash
+    # bounded by the stage count instead of the microbatch count, losses
+    # and grads bitwise-equal to gpipe — see parallel/pipeline.py).
     pp_schedule: str = "gpipe"
     pp_virtual_stages: int = 1
     # Mesh axes that live on DCN (multi-slice); all others ride ICI.
     dcn_axes: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        # Domain checks only, matching ModelConfig's rule (cross-field
+        # constraints live in the Trainer — dotted CLI overrides apply
+        # one field at a time).
+        if self.pp_schedule not in ("gpipe", "interleaved", "1f1b"):
+            raise ValueError(
+                f"parallel.pp_schedule={self.pp_schedule!r}; pick "
+                f"gpipe|interleaved|1f1b"
+            )
+        if self.pp_microbatches is None or self.pp_microbatches < 1:
+            raise ValueError(
+                f"parallel.pp_microbatches={self.pp_microbatches} must "
+                f"be >= 1"
+            )
+        if self.pp_virtual_stages is None or self.pp_virtual_stages < 1:
+            raise ValueError(
+                f"parallel.pp_virtual_stages={self.pp_virtual_stages} "
+                f"must be >= 1"
+            )
 
     @property
     def axis_sizes(self) -> Mapping[str, int]:
@@ -491,8 +515,11 @@ class TrainConfig:
     # step (XLA emits the reduce-scatter/all-gather pair); the losses and
     # the post-step full (all-gathered) state are bitwise-equal to the
     # unsharded dp baseline. Needs parallel.dp > 1; composes with
-    # grad_accum / scan_group / remat / fsdp / tp; rejected under pp until
-    # stage-local dp is plumbed. See PERF.md "ZeRO-1".
+    # grad_accum / scan_group / remat / fsdp / tp, and with parallel.pp
+    # (the update dim is picked per leaf AROUND the pp-sharded layer dim,
+    # so the reduce-scatter/all-gather run over dp within each stage's
+    # param shard — stage-local dp). Only zero1_quantize stays rejected
+    # under pp. See PERF.md "ZeRO-1".
     zero1: bool = False
     # Wire precision of the two ZeRO-1 collective legs on the (DCN-riding)
     # dp axis. None = full-precision legs via sharding constraints (the

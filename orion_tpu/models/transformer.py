@@ -601,7 +601,6 @@ def _hidden_states(
         return grouped, (group_fn if cfg.scan_group == 1
                          else _remat(group_fn))
 
-    pattern = cfg.window_pattern
     pp_active = (
         cfg.pipeline_axis is not None
         and mesh is not None
@@ -610,13 +609,6 @@ def _hidden_states(
     if pp_active:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires scan_layers=True")
-        if cfg.scan_group > 1:
-            raise ValueError(
-                "model.scan_group > 1 does not apply under pipeline "
-                "parallelism: the stage loop already iterates "
-                "pattern-group units and stage boundaries must stay "
-                "per-unit for the pp split (set scan_group=1)"
-            )
         from orion_tpu.parallel.pipeline import pipeline_forward
 
         # Packed sequences / custom positions are PER-ROW state: the
@@ -630,14 +622,17 @@ def _hidden_states(
             if segment_ids is not None:
                 row_state["segment_ids"] = segment_ids
 
-        if pattern is None:
+        if cfg.scan_unit == 1:
             pp_blocks = params["blocks"]
             pp_fn = _remat(make_block_fn(cfg.sliding_window, with_rs))
         else:
-            # Window-pattern (Gemma-family) models pipeline over pattern
-            # GROUPS — the grouped-scan unit, lifted into the stage body
-            # (the trainer validates the unit count splits over pp*V).
-            pp_blocks, pp_fn = layer_groups(pattern, with_rs)
+            # The stage body iterates the SAME unit the layer scan would:
+            # scan_group homogeneous layers times the window pattern
+            # (Gemma-family local/global groups), via the shared
+            # layer_groups — so scan_group composes with pp and grads
+            # stay bitwise across scan_group values (the trainer
+            # validates the unit count splits over pp*V).
+            pp_blocks, pp_fn = layer_groups(cfg.scan_unit, with_rs)
 
         x, moe_aux = pipeline_forward(
             x,

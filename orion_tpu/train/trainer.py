@@ -525,13 +525,6 @@ class Trainer:
                     "train.zero1 needs parallel.dp > 1: the optimizer "
                     "state shards 1/dp across the dp axis"
                 )
-            if cfg.parallel.pp > 1:
-                raise ValueError(
-                    "train.zero1 is rejected under parallel.pp until "
-                    "stage-local dp is plumbed (the update sharding "
-                    "assumes a global dp axis; pipeline stages own "
-                    "disjoint layer shards)"
-                )
             if cfg.train.grad_quant_bits:
                 raise ValueError(
                     "train.zero1 replaces the dp gradient all-reduce with "
@@ -539,6 +532,21 @@ class Trainer:
                     "collective left to quantize; use train.zero1_quantize"
                 )
             if cfg.train.zero1_quantize:
+                if cfg.parallel.pp > 1:
+                    # Named separately from the generic pure-DP check:
+                    # the full-precision zero1 path DOES compose with pp
+                    # (stage-local dp via sharding constraints), so this
+                    # is the one zero1 combo that stays rejected — the
+                    # int8 wire legs run shard_map manual over dp, and
+                    # nesting that inside the pipeline's pp-manual
+                    # region is unproven.
+                    raise ValueError(
+                        "train.zero1_quantize is rejected under "
+                        "parallel.pp: the int8 wire legs run manual "
+                        "over dp and cannot nest inside the pipeline's "
+                        "pp shard_map; use full-precision train.zero1 "
+                        "(composes with pp) or drop pp"
+                    )
                 others = {
                     k: v for k, v in cfg.parallel.axis_sizes.items()
                     if k != "dp" and v > 1
@@ -612,26 +620,24 @@ class Trainer:
                 "pp_virtual_stages > 1 requires pp_schedule=interleaved"
             )
         if cfg.parallel.pp > 1:
-            # Route the layer stack through the GPipe pipeline over pp
+            # Route the layer stack through the pipeline over pp
             # (parallel.pipeline); params/opt shard "layers" -> pp by rule.
-            if cfg.model.scan_group > 1:
-                raise ValueError(
-                    "model.scan_group > 1 is a layer-scan knob; under "
-                    "parallel.pp the stage loop already iterates "
-                    "pattern-group units (set scan_group=1)"
-                )
             pp, M = cfg.parallel.pp, cfg.parallel.pp_microbatches
             micro = cfg.data.batch_size // max(cfg.train.grad_accum, 1)
-            # Window-pattern (Gemma-family) models pipeline over GROUPS of
-            # `pattern` layers (the homogeneous unit); otherwise the unit
-            # is a single layer. Same source of truth as the forward pass
-            # (ModelConfig.window_pattern).
-            unit = cfg.model.window_pattern or 1
+            # The pipeline unit is the layer-scan unit: scan_group
+            # homogeneous layers times the window pattern (Gemma-family
+            # models group local/global layers). Same source of truth as
+            # the forward pass (ModelConfig.scan_unit), so scan_group
+            # composes with pp instead of being rejected.
+            unit = cfg.model.scan_unit
             n_units, rem = divmod(cfg.model.n_layers, unit)
             if rem or n_units % pp:
                 raise ValueError(
                     f"model.n_layers={cfg.model.n_layers} must split into "
-                    f"pattern groups of {unit} divisible by parallel.pp={pp}"
+                    f"scan units of {unit} (scan_group="
+                    f"{cfg.model.scan_group} x pattern="
+                    f"{cfg.model.window_pattern or 1}) divisible by "
+                    f"parallel.pp={pp}"
                 )
             if M < 1 or micro % M:
                 raise ValueError(
@@ -646,8 +652,9 @@ class Trainer:
                 if n_units % (pp * V):
                     raise ValueError(
                         f"model.n_layers={cfg.model.n_layers} gives "
-                        f"{n_units} pipeline units (pattern {unit}); must "
-                        f"be divisible by pp*pp_virtual_stages ({pp}*{V})"
+                        f"{n_units} pipeline units (scan unit {unit}); "
+                        f"must be divisible by pp*pp_virtual_stages "
+                        f"({pp}*{V})"
                     )
                 if M > pp:
                     raise ValueError(

@@ -332,9 +332,12 @@ def test_zero1_update_dim_choice():
 def test_zero1_validation():
     with pytest.raises(ValueError, match="dp > 1"):
         Trainer(_cfg(["train.zero1=true"]))
-    with pytest.raises(ValueError, match="stage-local dp"):
+    # zero1 x pp is SUPPORTED now (stage-local dp, ISSUE 13); the combo
+    # that stays rejected is the int8 wire legs under pp
+    # (tests/test_pipeline_1f1b.py pins both directions).
+    with pytest.raises(ValueError, match="zero1_quantize is rejected"):
         Trainer(_cfg(["train.zero1=true", "parallel.pp=2",
-                      "parallel.dp=2"]))
+                      "parallel.dp=2", "train.zero1_quantize=int8"]))
     with pytest.raises(ValueError, match="without train.zero1"):
         Trainer(_cfg(["train.zero1_quantize=int8"]))
     with pytest.raises(ValueError, match="grad_quant_bits"):

@@ -67,6 +67,14 @@ QUEUE = [
     # pin, measured on real hardware (the --smoke twin rides tier-1).
     ("router",
      [sys.executable, str(ROOT / "tools/router_bench.py")], 1800),
+    # 1F1B pipeline probes (ISSUE 13): pp=2 needs a multi-chip window —
+    # the pp_1f1b / pp_1f1b_zero1 TRAIN_PROBES above ride `--probe all`
+    # (1-chip window records a fast config error); this entry is the
+    # schedule-table twin (gpipe vs interleaved vs 1f1b occupancy + the
+    # peak-activation-bytes column) on real chips. On the CPU fallback
+    # it reproduces the fake-mesh table (the --smoke twin rides tier-1).
+    ("pp_1f1b",
+     [sys.executable, str(ROOT / "tools/pp_bubble_bench.py")], 2700),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
