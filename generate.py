@@ -66,7 +66,11 @@ def main(argv: list[str] | None = None) -> int:
                              "serve to PATH (request-lifecycle spans + "
                              "per-dispatch timing; load in Perfetto); "
                              "sugar for inference.trace=true + "
-                             "inference.trace_path=PATH")
+                             "inference.trace_path=PATH. With --replicas "
+                             "N, PATH is the MERGED fleet timeline "
+                             "(router + every replica on a shared clock) "
+                             "and each replica also exports its own "
+                             "trace.replica-k.json alongside")
     parser.add_argument("--replicas", type=int, default=None, metavar="N",
                         help="multi-replica serving: run N engine "
                              "replicas behind the health-checked router "
@@ -210,10 +214,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.trace:
         # Re-export explicitly so the success message reflects THIS run
         # (a stale file from a previous serve must not mask a failure).
+        # On a Router this is the MERGED fleet timeline: router + every
+        # replica ring on a shared clock (per-replica namespaced traces
+        # were written by each live replica's close() above).
         try:
-            engine.export_trace(args.trace)
-            print(f"trace written to {args.trace} (open in Perfetto, or "
-                  f"run tools/obs_report.py {args.trace})")
+            n = engine.export_trace(args.trace)
+            fleet = " (merged fleet timeline)" if (
+                cfg.router.replicas > 1
+            ) else ""
+            print(f"trace written to {args.trace}{fleet}: {n} events "
+                  f"(open in Perfetto, or run "
+                  f"tools/obs_report.py {args.trace})")
         except OSError as e:
             print(f"trace export to {args.trace} failed: {e}",
                   file=sys.stderr)

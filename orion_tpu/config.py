@@ -913,6 +913,12 @@ class RouterConfig:
     # closes the breaker, any new trip re-opens it (and re-arms the
     # timer), so a flapping replica converges to mostly-open.
     probe_after_steps: int = 8
+    # Breaker-postmortem routing context (ISSUE 14 satellite): the router
+    # keeps a ring of the last N placement decisions (replica,
+    # match_tokens, the load gauges read at placement) and attaches it to
+    # the flight-recorder note a breaker trip writes — a postmortem shows
+    # WHY traffic was where it was when the breaker opened.
+    decision_log: int = 16
     # Backoff-jitter PRNG seed (placement itself is deterministic).
     seed: int = 0
 
@@ -934,11 +940,133 @@ class RouterConfig:
                 raise ValueError(f"router.{name}={v} must be >= 0")
         for name in (
             "break_after", "break_failed_steps", "break_quarantined",
-            "probe_after_steps",
+            "probe_after_steps", "decision_log",
         ):
             v = getattr(self, name)
             if v is None or v < 1:
                 raise ValueError(f"router.{name}={v} must be >= 1")
+
+
+def parse_per_class(spec: str) -> dict[int, dict[str, float]]:
+    """Parse the ``slo.per_class`` objective spec: semicolon-separated
+    ``<class>:<metric>=<target_ms>[,<metric>=<target_ms>]`` entries, e.g.
+    ``"2:ttft=200,itl=40;0:ttft=1000"`` — priority class 2 must see TTFT
+    <= 200 ms and ITL <= 40 ms, class 0 TTFT <= 1000 ms. Metrics are
+    ``ttft`` | ``itl``; returns ``{cls: {metric: target_ms}}``. Lives in
+    config.py (pure string parsing, no deps) so SLOConfig validation and
+    obs/slo.py's objective builder share ONE grammar."""
+    out: dict[int, dict[str, float]] = {}
+    if not spec:
+        return out
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"slo.per_class entry {entry!r} needs <class>:<metric>="
+                f"<target_ms>[,...]"
+            )
+        cls_s, targets_s = entry.split(":", 1)
+        try:
+            cls = int(cls_s.strip())
+        except ValueError as e:
+            raise ValueError(
+                f"slo.per_class class {cls_s!r} is not an int"
+            ) from e
+        targets: dict[str, float] = {}
+        for kv in targets_s.split(","):
+            kv = kv.strip()
+            if "=" not in kv:
+                raise ValueError(
+                    f"slo.per_class target {kv!r} needs <metric>="
+                    f"<target_ms>"
+                )
+            metric, ms_s = (s.strip() for s in kv.split("=", 1))
+            if metric not in ("ttft", "itl"):
+                raise ValueError(
+                    f"slo.per_class metric {metric!r} must be ttft|itl"
+                )
+            try:
+                ms = float(ms_s)
+            except ValueError as e:
+                raise ValueError(
+                    f"slo.per_class target {ms_s!r} is not a number"
+                ) from e
+            if ms <= 0:
+                raise ValueError(
+                    f"slo.per_class target {metric}={ms} must be > 0 ms"
+                )
+            targets[metric] = ms
+        if cls in out:
+            raise ValueError(
+                f"slo.per_class repeats class {cls}"
+            )
+        out[cls] = targets
+    return out
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Serving SLO objectives + burn-rate monitoring (obs/slo.py;
+    ISSUE 14). Off by default (no objective configured -> no monitor, no
+    per-step cost). The router judges per-priority-class TTFT/ITL
+    against these objectives over rolling windows; a window burning the
+    error budget faster than ``burn_threshold`` is a typed
+    ``slo_breach`` (tracer instant + flight-recorder note/dump +
+    registry gauge)."""
+
+    # Fleet-wide latency objectives in ms (every priority class counts
+    # toward them). None disables that objective.
+    ttft_ms: Optional[float] = None
+    itl_ms: Optional[float] = None
+    # Fraction of events that must meet the target: 0.99 = 1% error
+    # budget. A window's burn rate is (violating fraction) / (1 - goal).
+    goal: float = 0.99
+    # Rolling judgment window, seconds. Windows open at the first
+    # observation and close at the first sweep past window_s.
+    window_s: float = 5.0
+    # Burn rate above which a window is a breach: 1.0 = budget burning
+    # exactly at the allowed rate (the classic page threshold is higher,
+    # e.g. 14.4 for a 1h window of a 30d budget — serving steps are
+    # seconds, so the default alerts on any over-budget window).
+    burn_threshold: float = 1.0
+    # Minimum observations before a window is judged for an objective —
+    # an empty (or too-thin) class window is no evidence, never a breach.
+    min_events: int = 1
+    # Per-priority-class overrides: "<cls>:<metric>=<target_ms>,...;..."
+    # e.g. "2:ttft=200,itl=40;0:ttft=1000" (see parse_per_class).
+    per_class: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.ttft_ms is not None
+            or self.itl_ms is not None
+            or bool(self.per_class)
+        )
+
+    def __post_init__(self):
+        for name in ("ttft_ms", "itl_ms"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"slo.{name}={v} must be > 0 (or none)")
+        if self.goal is None or not 0.0 < self.goal < 1.0:
+            raise ValueError(
+                f"slo.goal={self.goal} must be in (0, 1) — 1.0 leaves "
+                f"no error budget to burn"
+            )
+        if self.window_s is None or self.window_s <= 0:
+            raise ValueError(f"slo.window_s={self.window_s} must be > 0")
+        if self.burn_threshold is None or self.burn_threshold <= 0:
+            raise ValueError(
+                f"slo.burn_threshold={self.burn_threshold} must be > 0"
+            )
+        if self.min_events is None or self.min_events < 1:
+            raise ValueError(
+                f"slo.min_events={self.min_events} must be >= 1"
+            )
+        parse_per_class(self.per_class)   # raises on a malformed spec
 
 
 @dataclass(frozen=True)
@@ -968,6 +1096,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
 
     def to_dict(self) -> dict:

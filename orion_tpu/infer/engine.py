@@ -280,6 +280,7 @@ class InferenceEngine:
             snapshot=self.registry.snapshot,
             injector=self._injector,
         )
+        self._register_trace_metrics()
         self._ttft_seen: set[int] = set()   # rids with a first_token event
         self._closed = False
 
@@ -441,6 +442,29 @@ class InferenceEngine:
         reg.register("pool", self._pool_metrics)
         reg.register("hbm", live_hbm_metrics)
 
+    def _register_trace_metrics(self) -> None:
+        """Ring-occupancy gauges ("trace" section: events/capacity/
+        dropped), registered only when tracing is actually on — the
+        obs-off snapshot (and thus the Prometheus/JSONL row set) stays
+        byte-identical to the pre-obs engine. A nonzero ``dropped`` means
+        any export from this ring is a truncated timeline (ISSUE 14
+        satellite; obs_report flags it)."""
+        if self._tracer.enabled:
+            self.registry.register("trace", self._tracer.metrics)
+
+    @staticmethod
+    def _trace_ctx(req: Request) -> dict:
+        """Correlation tags for a lifecycle instant: ``tid`` (the fleet
+        trace id — the router's request id when routed, the engine rid on
+        a bare engine) plus ``retried=attempt`` on failover re-placements
+        (attempt > 0), so a failed-over request's instants on BOTH
+        replicas' tracks carry the same tid and the retry is visible in
+        the merged timeline."""
+        tid = req.trace_id if req.trace_id is not None else req.rid
+        if req.attempt:
+            return {"tid": tid, "retried": req.attempt}
+        return {"tid": tid}
+
     def _pool_metrics(self) -> dict:
         """Page-pool and radix-tree occupancy gauges. ``occupancy`` counts
         the usable pool (page 0 is the reserved scratch page); cached
@@ -473,8 +497,19 @@ class InferenceEngine:
         NOT credited (the pre-refactor behavior: a failed step's partial
         span never lands in the timing split) but the tracer span still
         records — a postmortem wants to see the dispatch that died."""
+        tags = {"step": self.step_no}
+        if self._tracer.enabled:
+            # Dispatch spans carry the trace ids of every live slot they
+            # computed for (ISSUE 14): a request's correlated track in
+            # the merged timeline includes the device work that advanced
+            # it, not just its lifecycle instants. Built only when the
+            # tracer is on — the untraced host path is unchanged.
+            tags["tids"] = [
+                r.trace_id if r.trace_id is not None else r.rid
+                for r in self.slots if r is not None and not r.done
+            ]
         t0 = time.perf_counter()
-        with self._tracer.span("dispatch/" + path, step=self.step_no):
+        with self._tracer.span("dispatch/" + path, **tags):
             yield
         setattr(self, bucket, getattr(self, bucket) + time.perf_counter() - t0)
 
@@ -495,6 +530,13 @@ class InferenceEngine:
         """Export the span ring as Chrome trace-event JSON (Perfetto);
         returns the number of events written (0 when tracing is off)."""
         return self._tracer.export_chrome(path)
+
+    @property
+    def tracer(self):
+        """The engine's span tracer (NULL_TRACER when obs is off) — the
+        router reads it to merge this replica's ring into the fleet
+        timeline (obs.merge_chrome)."""
+        return self._tracer
 
     # -- dispatch + degradation ladder (infer/executor.py) ----------------
 
@@ -671,11 +713,18 @@ class InferenceEngine:
         top_p: Optional[float] = None,
         deadline_s: Optional[float] = None,
         priority: int = 0,
+        trace_id: Optional[int] = None,
+        attempt: int = 0,
     ) -> Request:
         """submit() returning the live Request object instead of its id —
         the CLI/bench/driver surface: callers poll ``.generated`` for
         incremental tokens and read the typed ``.outcome`` at the end.
-        Same arguments and validation as submit()."""
+        Same arguments and validation as submit(). ``trace_id`` /
+        ``attempt`` are the fleet trace context (ISSUE 14): the router
+        stamps its request id and failover attempt number here so this
+        replica's lifecycle instants correlate in the merged timeline;
+        bare-engine callers leave them defaulted (tid falls back to the
+        engine rid)."""
         if not len(prompt):
             raise ValueError("empty prompt")
         if temperature is not None and temperature < 0.0:
@@ -741,13 +790,15 @@ class InferenceEngine:
                 time.monotonic() + deadline_s
                 if deadline_s is not None else None
             ),
+            trace_id=trace_id,
+            attempt=int(attempt),
         )
         if self._tracer.enabled:
             self._tracer.instant(
                 "submit", rid=req.rid, priority=req.priority,
                 prompt_tokens=len(req.prompt),
                 max_new_tokens=req.max_new_tokens,
-                deadline_s=deadline_s,
+                deadline_s=deadline_s, **self._trace_ctx(req),
             )
         if self.draining:
             # Admission is stopped (SIGTERM drain): typed shed, never
@@ -929,13 +980,15 @@ class InferenceEngine:
                 ):
                     self._ttft_seen.add(r.rid)
                     self._tracer.instant(
-                        "first_token", rid=r.rid, step=self.step_no
+                        "first_token", rid=r.rid, step=self.step_no,
+                        **self._trace_ctx(r),
                     )
             for r in self._just_finished:
                 self._ttft_seen.discard(r.rid)
                 self._tracer.instant(
                     "outcome", rid=r.rid, outcome=r.outcome,
                     tokens=len(r.generated), step=self.step_no,
+                    **self._trace_ctx(r),
                 )
             self._tracer.record_span(
                 "step", m0, time.monotonic(), step=self.step_no,
@@ -1588,6 +1641,7 @@ class InferenceEngine:
                         len(context) - 1 if full
                         else n_match * self.psz
                     ),
+                    **self._trace_ctx(req),
                 )
             icfg = self.icfg
             self.slot_temp[slot] = (

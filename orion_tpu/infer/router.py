@@ -36,6 +36,23 @@ Router decisions (route / retry / break / probe) are emitted as tracer
 instants and flight-recorder events, exactly like the engine's own
 request lifecycle. ``router.replicas=1`` is a pass-through: byte-identical
 greedy streams to the bare engine (pinned in tests/test_router.py).
+
+Fleet observability plane (ISSUE 14): the router is the fleet's ONE
+obs surface. Every request carries its router rid as a **trace id**
+through every engine attempt (engine lifecycle instants + dispatch spans
+tag ``tid``), so route -> admit -> chunks -> verify -> failover ->
+re-queue -> outcome is a single correlated track across processes.
+Replica engines export to **namespaced sinks**
+(``trace.replica-k.json``, metrics JSONL/prom likewise — PR 11 stripped
+their targets instead) and ``close()`` additionally merges the router's
+ring plus all N replica rings into ONE Perfetto timeline on a shared
+monotonic clock (``obs.merge_chrome``). The router registry snapshots
+every replica registry under ``replica<k>.*`` sections plus ``fleet``
+rollups (aggregate typed outcomes, total pool occupancy, breaker-state
+gauges) behind the same Prometheus/JSONL exporters — one scrape surface
+for the fleet — and an **SLO monitor** (obs/slo.py, ``cfg.slo``) judges
+per-priority-class TTFT/ITL burn rates, emitting typed ``slo_breach``
+events into the flight recorder and burn gauges into the registry.
 """
 
 from __future__ import annotations
@@ -53,7 +70,14 @@ from orion_tpu.config import Config
 from orion_tpu.infer.engine import InferenceEngine
 from orion_tpu.infer.scheduler import Request
 from orion_tpu.metrics import RouterStats
-from orion_tpu.obs import MetricsRegistry, export_chrome_safe, init_obs
+from orion_tpu.obs import (
+    MetricsRegistry,
+    SLOMonitor,
+    init_obs,
+    merge_chrome,
+    merge_chrome_safe,
+    namespaced_path,
+)
 from orion_tpu.runtime.fault import (
     DispatchFault,
     FaultInjector,
@@ -93,6 +117,17 @@ class RouterRequest:
     due_step: int = 0           # backoff gate: no placement before this
     emitted: int = 0            # stream() high-water mark (dedup)
     placed: bool = False        # ever admitted to some engine
+    # SLO observation state (obs/slo.py; router-side host wall clock):
+    # t_first/t_last stamp token arrivals as the router's step loop sees
+    # them, slo_seen is the observation high-water mark — a failover's
+    # regenerated prefix (generated drops back to []) re-observes nothing
+    # until it passes the mark, mirroring stream()'s dedup: the SLO clock
+    # measures the CLIENT-VISIBLE wait, which kept running through the
+    # failover.
+    t_submit: float = 0.0
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+    slo_seen: int = 0
 
     @property
     def generated(self) -> list[int]:
@@ -152,17 +187,33 @@ class Router:
         self.cfg = cfg
         self.rcfg = cfg.router
         self.icfg = cfg.inference
-        # Replica engines own no export targets: the ROUTER exports the
-        # trace/metrics (N engines rewriting one trace_path/prom file
-        # would clobber each other); flight dumps stay per-engine (file
-        # names are unique) so a replica postmortem is still written.
-        rep_icfg = dataclasses.replace(
-            cfg.inference,
-            trace_path=None, metrics_jsonl=None, metrics_prom=None,
-        )
-        rep_cfg = dataclasses.replace(cfg, inference=rep_icfg)
+        # Replica engines export to NAMESPACED sinks (ISSUE 14; PR 11
+        # stripped their targets so N engines wouldn't clobber one
+        # trace_path/prom file): inference.trace_path=/x/trace.json gives
+        # replica k /x/trace.replica-k.json (metrics JSONL/prom
+        # likewise), while the ROUTER owns the configured paths — the
+        # merged fleet timeline at trace_path, the aggregated registry at
+        # metrics_*. Flight dumps were always per-engine-unique file
+        # names and stay as they were.
         self.handles: list[ReplicaHandle] = []
         for i in range(self.rcfg.replicas):
+            tag = f"replica-{i}"
+            rep_icfg = dataclasses.replace(
+                cfg.inference,
+                trace_path=(
+                    namespaced_path(self.icfg.trace_path, tag)
+                    if self.icfg.trace_path else None
+                ),
+                metrics_jsonl=(
+                    namespaced_path(self.icfg.metrics_jsonl, tag)
+                    if self.icfg.metrics_jsonl else None
+                ),
+                metrics_prom=(
+                    namespaced_path(self.icfg.metrics_prom, tag)
+                    if self.icfg.metrics_prom else None
+                ),
+            )
+            rep_cfg = dataclasses.replace(cfg, inference=rep_icfg)
             inj = FaultInjector()
             eng = InferenceEngine(
                 rep_cfg, params, eos_id=eos_id, seed=seed + i,
@@ -178,8 +229,22 @@ class Router:
         self._just_finished: list[RouterRequest] = []
         self._rid = itertools.count()
         self._rng = random.Random(self.rcfg.seed)
+        # Last-K routing decisions (router.decision_log): attached to the
+        # flight note a breaker trip writes, so a postmortem shows why
+        # traffic was where it was when the breaker opened.
+        self._decisions: deque[dict] = deque(maxlen=self.rcfg.decision_log)
         self.registry = MetricsRegistry()
         self.registry.register("router", self._router_metrics)
+        # Aggregated fleet registry (ISSUE 14): every replica's registry
+        # snapshots under its own namespaced section plus fleet rollups —
+        # one scrape surface. Providers are lazy (priced at export/dump
+        # time only), so registering N sections costs nothing per step.
+        for h in self.handles:
+            self.registry.register(
+                f"replica{h.idx}",
+                lambda h=h: h.engine.registry.snapshot(),
+            )
+        self.registry.register("fleet", self._fleet_metrics)
         self._tracer, self._flight = init_obs(
             trace=self.icfg.trace,
             trace_ring=self.icfg.trace_ring,
@@ -188,6 +253,14 @@ class Router:
             snapshot=self.registry.snapshot,
             injector=fault_injector,
         )
+        if self._tracer.enabled:
+            self.registry.register("trace", self._tracer.metrics)
+        # SLO burn-rate monitor (obs/slo.py; cfg.slo): None when no
+        # objective is configured — the step loop then skips observation
+        # entirely (obs-off serving stays byte-identical).
+        self._slo = SLOMonitor.from_config(cfg.slo)
+        if self._slo is not None:
+            self.registry.register("slo", self._slo.metrics)
 
     # -- observability -----------------------------------------------------
 
@@ -206,21 +279,103 @@ class Router:
             "step_no": self.step_no,
         }
 
+    def _fleet_metrics(self) -> dict:
+        """Fleet rollups (the ``fleet`` registry section): aggregate
+        queue/slot gauges, total pool + radix occupancy, and the summed
+        per-replica typed-outcome/fault counters (RobustnessStats) across
+        LIVE replicas — a dead replica models a killed process, whose
+        state no scrape could read. Breaker-state gauges live in the
+        ``router`` section (``replicas_closed``/`open`/...)."""
+        gauges = {"waiting": 0, "active": 0, "preemptions": 0}
+        pool = {
+            "num_pages": 0, "free_pages": 0, "cached_pages": 0,
+            "evictable_pages": 0,
+        }
+        robust: dict[str, float] = {}
+        live = 0
+        for h in self.handles:
+            if h.dead:
+                continue
+            live += 1
+            snap = h.engine.registry.snapshot(
+                sections=("engine", "pool", "robust")
+            )
+            for k in gauges:
+                gauges[k] += snap.get(f"engine.{k}", 0)
+            for k in pool:
+                pool[k] += snap.get(f"pool.{k}", 0)
+            for k, v in snap.items():
+                if k.startswith("robust.") and isinstance(v, (int, float)):
+                    key = k[len("robust."):]
+                    robust[key] = robust.get(key, 0) + v
+        # Page 0 is each replica's reserved scratch page (engine
+        # _pool_metrics contract), so the fleet's usable pool is
+        # num_pages minus one per live replica. Zero live replicas have
+        # zero pool: occupancy 0.0, not the 1.0 the degenerate division
+        # would report (an alert keyed on this gauge must read a total
+        # outage as "no pool", never "pool full").
+        usable = max(pool["num_pages"] - live, 1)
+        return {
+            "live_replicas": live,
+            **gauges,
+            **pool,
+            "pool_occupancy": (
+                (usable - pool["free_pages"]) / usable if live else 0.0
+            ),
+            **robust,
+        }
+
     def _flight_note(self, kind: str, **fields) -> None:
         if self._flight is not None:
             self._flight.note(kind, step=self.step_no, **fields)
 
     def export_trace(self, path: str) -> int:
-        """Export the router's span ring (route/retry/break/probe plus
-        request lifecycle) as Chrome trace-event JSON."""
-        return self._tracer.export_chrome(path)
+        """Export the MERGED fleet timeline — the router's span ring
+        (route/retry/break/probe plus request lifecycle) and every
+        replica engine's ring as one Perfetto trace on a shared clock,
+        one process per source (obs.merge_chrome). Killed replicas'
+        rings are still in-process, so their final spans appear too.
+        Returns events written (0 when tracing is off everywhere)."""
+        # Raises on a write failure (unlike close()'s merge_chrome_safe):
+        # this is the explicit-export path — generate.py --trace catches
+        # OSError and reports the failure honestly instead of pointing
+        # the user at a file that was never written.
+        sources = self._trace_sources()
+        if not any(tr.enabled for _, tr in sources):
+            return 0
+        return merge_chrome(path, sources)
+
+    def _trace_sources(self) -> list:
+        return [("router", self._tracer)] + [
+            (f"replica-{h.idx}", h.engine.tracer) for h in self.handles
+        ]
 
     def reset_timing(self) -> dict:
         """Drain the router-level counters (RouterStats) plus breaker/
-        queue gauges. Per-replica serving windows stay with each engine's
-        own ``reset_timing`` — the router never aggregates them away."""
+        queue gauges, and — when inference.metrics_jsonl/_prom are set —
+        flush the AGGREGATED fleet snapshot (router + fleet rollups + SLO
+        + every replica section) through the exporters: one scrape
+        surface for the fleet, exactly like the engine's own drain-point
+        export. Per-replica serving windows stay with each engine's own
+        ``reset_timing`` — the router never aggregates them away."""
         out = self._router_metrics()
+        if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
+            # Snapshot BEFORE the drain zeroes RouterStats, so the
+            # exported row carries the window being drained, not zeros.
+            row = self.registry.snapshot()
         self.stats = RouterStats()
+        if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
+            try:
+                if self.icfg.metrics_jsonl:
+                    self.registry.export_jsonl(
+                        self.icfg.metrics_jsonl, snapshot=row
+                    )
+                if self.icfg.metrics_prom:
+                    self.registry.export_prometheus(
+                        self.icfg.metrics_prom, snapshot=row
+                    )
+            except OSError as e:
+                log.error("router metrics export failed: %s", e)
         return out
 
     # -- public API --------------------------------------------------------
@@ -268,10 +423,11 @@ class Router:
                 time.monotonic() + deadline_s
                 if deadline_s is not None else None
             ),
+            t_submit=time.monotonic(),
         )
         if self._tracer.enabled:
             self._tracer.instant(
-                "submit", rid=rr.rid, priority=rr.priority,
+                "submit", rid=rr.rid, tid=rr.rid, priority=rr.priority,
                 prompt_tokens=len(rr.prompt), deadline_s=deadline_s,
             )
         if self.draining:
@@ -343,8 +499,69 @@ class Router:
                 if rr is None:
                     continue    # failed over / cancelled by the router
                 self._finish(h, rr, er, done)
+        if self._slo is not None:
+            self._observe_slo(done)
         self.step_no += 1
         return done
+
+    # -- SLO monitoring (obs/slo.py; cfg.slo) ------------------------------
+
+    def _observe_slo(self, done: list[RouterRequest]) -> None:
+        """Per-step SLO observation + window sweep: record TTFT/ITL for
+        every request that grew tokens this step (in flight anywhere, or
+        surfacing now), then let the monitor judge any window that
+        closed. A breach is a typed event: tracer instant + flight note
+        AND dump (throttled like every other postmortem trigger) +
+        RouterStats counter; the burn gauges ride the ``slo`` registry
+        section."""
+        now = time.monotonic()
+        for h in self.handles:
+            for rr in h.inflight.values():
+                self._slo_track(rr, now)
+        for rr in done:
+            self._slo_track(rr, now)
+        self._handle_breaches(self._slo.sweep(now))
+
+    def _handle_breaches(self, breaches: list[dict]) -> None:
+        for breach in breaches:
+            self.stats.slo_breaches += 1
+            log.error("SLO breach: %s", breach)
+            if self._tracer.enabled:
+                self._tracer.instant(
+                    "slo_breach", step=self.step_no, **breach
+                )
+            # router_-prefixed like every other router flight kind:
+            # note() mirrors into the tracer, and a second bare
+            # "slo_breach" instant would double-count the breach in
+            # obs_report's burn panel and fleet timeline.
+            self._flight_note("router_slo_breach", **breach)
+            if self._flight is not None:
+                self._flight.try_dump(
+                    "slo_breach", step=self.step_no, **breach
+                )
+
+    def _slo_track(self, rr: RouterRequest, now: float) -> None:
+        """Observe one request's token progress on the router's host
+        clock: TTFT at the first token past submit, one ITL gap per
+        step that grew tokens (same-step extras arrive together — gap
+        0.0, matching the bench collectors' convention). ``slo_seen`` is
+        a high-water mark, so a failover's regenerated prefix is not
+        re-observed — the client-visible clock never restarted."""
+        n = len(rr.generated)
+        if n <= rr.slo_seen:
+            return
+        new = n - rr.slo_seen
+        if rr.t_first is None:
+            rr.t_first = now
+            self._slo.observe("ttft", rr.priority, now - rr.t_submit, now)
+        else:
+            self._slo.observe(
+                "itl", rr.priority, now - rr.t_last, now
+            )
+        for _ in range(new - 1):
+            self._slo.observe("itl", rr.priority, 0.0, now)
+        rr.t_last = now
+        rr.slo_seen = n
 
     def drain(self) -> list[RouterRequest]:
         """Graceful fleet shutdown: stop admission, shed never-placed
@@ -368,20 +585,38 @@ class Router:
 
     def close(self) -> None:
         """Close every live replica (dead replicas model a killed process
-        — only their watchdog thread is reaped) and export the router's
-        trace when inference.trace_path is set. Idempotent; admission
+        — only their watchdog thread is reaped; their per-replica
+        namespaced trace file is never written, but their ring is still
+        in-process and lands in the merge), flush the aggregated metrics
+        exporters, and write the MERGED fleet timeline to
+        inference.trace_path (live replicas also exported their own
+        namespaced traces in engine.close()). Idempotent; admission
         stays stopped afterwards."""
         self.draining = True
         if self._closed:
             return
         self._closed = True
+        if self._slo is not None:
+            # Final FORCED sweep: a serve shorter than slo.window_s still
+            # gets one verdict over its partial tail window before the
+            # gauges are exported below.
+            self._handle_breaches(
+                self._slo.sweep(time.monotonic(), force=True)
+            )
         for h in self.handles:
             if h.dead:
                 if h.engine._watchdog is not None:
                     h.engine._watchdog.stop()
             else:
                 h.engine.close()
-        export_chrome_safe(self._tracer, self.icfg.trace_path)
+        if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
+            # Final fleet drain, mirroring engine.close(): a short-lived
+            # serve that never called reset_timing still flushes its tail
+            # window through the aggregated exporters (reset_timing is
+            # where the export actually happens, and it is now a no-op
+            # window — replicas already flushed their own sinks above).
+            self.reset_timing()
+        merge_chrome_safe(self.icfg.trace_path, self._trace_sources())
 
     def generate(
         self,
@@ -525,6 +760,10 @@ class Router:
             )
         self._flight_note(
             "router_break", replica=h.idx, reason=reason, killed=kill,
+            # The last K routing decisions (replica, match_tokens, load
+            # gauges at placement): the postmortem shows WHY traffic was
+            # where it was when the breaker opened (ISSUE 14 satellite).
+            recent_routes=list(self._decisions),
         )
         victims = list(h.inflight.values())
         h.inflight.clear()
@@ -584,7 +823,7 @@ class Router:
         )
         if self._tracer.enabled:
             self._tracer.instant(
-                "retry", rid=rr.rid, attempt=rr.retries,
+                "retry", rid=rr.rid, tid=rr.rid, attempt=rr.retries,
                 backoff_steps=delay, reason=why, step=self.step_no,
             )
         self._flight_note(
@@ -609,7 +848,7 @@ class Router:
         rr.outcome = outcome
         if self._tracer.enabled:
             self._tracer.instant(
-                "outcome", rid=rr.rid, outcome=outcome,
+                "outcome", rid=rr.rid, tid=rr.rid, outcome=outcome,
                 retried=rr.retries, tokens=len(rr.generated),
                 step=self.step_no,
             )
@@ -709,6 +948,13 @@ class Router:
         if picked is None:
             return False
         h, affinity, match = picked
+        # Load gauges for the decision log, read at the moment of the
+        # CHOICE — after admission the snapshot would include the very
+        # request being placed, and the postmortem would show the router
+        # picking an already-loaded replica that was actually idle.
+        load_key = (
+            self._load_key(h) if self._flight is not None else None
+        )
         deadline_s = None
         if rr.deadline is not None:
             deadline_s = rr.deadline - time.monotonic()
@@ -721,6 +967,11 @@ class Router:
                 temperature=rr.temperature, top_k=rr.top_k,
                 top_p=rr.top_p, deadline_s=deadline_s,
                 priority=rr.priority,
+                # Trace context (ISSUE 14): the router rid is the fleet
+                # trace id; the replica's lifecycle instants and dispatch
+                # spans tag it, so this attempt correlates with the
+                # router track (and any prior attempt) in the merge.
+                trace_id=rr.rid, attempt=rr.retries,
             )
         except ValueError:
             if raise_errors:
@@ -744,11 +995,25 @@ class Router:
         probe = h.state == HALF_OPEN
         if probe:
             h.probe_rid = er.rid
+        if load_key is not None:
+            # Decision log (router.decision_log): the placement plus the
+            # load gauges it read, ringed for the breaker-trip
+            # postmortem note. Recorded only when the flight recorder —
+            # its sole consumer — exists, so an obs-off fleet pays no
+            # extra registry read per placement.
+            queued, occupancy, itl, _ = load_key
+            self._decisions.append({
+                "step": self.step_no, "rid": rr.rid, "replica": h.idx,
+                "match_tokens": match, "affinity": affinity,
+                "retried": rr.retries, "queued": queued,
+                "occupancy": round(float(occupancy), 4),
+                "itl_proxy_s": round(float(itl), 6),
+            })
         if self._tracer.enabled:
             self._tracer.instant(
-                "route", rid=rr.rid, replica=h.idx, match_tokens=match,
-                affinity=affinity, probe=probe, retried=rr.retries,
-                step=self.step_no,
+                "route", rid=rr.rid, tid=rr.rid, replica=h.idx,
+                match_tokens=match, affinity=affinity, probe=probe,
+                retried=rr.retries, step=self.step_no,
             )
         return True
 

@@ -47,6 +47,16 @@ class Request:
     # Every submitted request surfaces from step() with an outcome — no
     # silent drops.
     outcome: str = ""
+    # Trace context (ISSUE 14): the fleet-level correlation id stamped by
+    # the router at submit and carried through every engine attempt —
+    # engine-side rids are per-replica and change across failover, so
+    # lifecycle instants tag ``tid`` (trace_id, falling back to rid on a
+    # bare engine) to make one request's journey a single correlated
+    # track in the merged timeline. ``attempt`` is the failover attempt
+    # number (0 = first placement); retried attempts tag their instants
+    # ``retried=attempt``.
+    trace_id: Optional[int] = None
+    attempt: int = 0
     # scheduler state
     slot: Optional[int] = None
     pages: list[int] = field(default_factory=list)

@@ -246,7 +246,9 @@ class RouterStats:
     engine-level sheds stay in the engine's own stats. Breaker:
     ``breaks`` OPEN trips (health sweep or a step() escalation),
     ``kills`` the replica_kill subset, ``probes`` OPEN->HALF_OPEN
-    transitions, ``recoveries`` probes that closed the breaker.
+    transitions, ``recoveries`` probes that closed the breaker. SLO
+    (ISSUE 14): ``slo_breaches`` counts typed ``slo_breach`` events the
+    burn-rate monitor (obs/slo.py) fired this window.
     """
 
     routed: int = 0
@@ -258,6 +260,7 @@ class RouterStats:
     kills: int = 0
     probes: int = 0
     recoveries: int = 0
+    slo_breaches: int = 0
 
     def as_timing(self) -> dict[str, float]:
         return {
@@ -270,6 +273,7 @@ class RouterStats:
             "kills": self.kills,
             "probes": self.probes,
             "recoveries": self.recoveries,
+            "slo_breaches": self.slo_breaches,
         }
 
 

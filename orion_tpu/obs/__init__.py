@@ -1,13 +1,19 @@
-"""Observability: span tracing, flight recording, metrics registry.
+"""Observability: span tracing, flight recording, metrics registry, SLO
+burn-rate monitoring.
 
-The serving engine and the trainer both thread through this package
-(ISSUE 9): ``Tracer`` is the host-side span/event ring (Chrome trace-event
-export, ``jax.profiler`` annotation passthrough for device-profile
-alignment), ``FlightRecorder`` the bounded postmortem ring that auto-dumps
-on degradation triggers, and ``MetricsRegistry`` the named-snapshot surface
-unifying the per-subsystem Stats dataclasses (metrics.py) with pool
-occupancy and live-HBM gauges, exportable as Prometheus textfiles and
-JSONL time series.
+The serving engine, the trainer, and the multi-replica router all thread
+through this package (ISSUEs 9 + 14): ``Tracer`` is the host-side
+span/event ring (Chrome trace-event export with overflow accounting,
+``jax.profiler`` annotation passthrough for device-profile alignment;
+``merge_chrome`` merges the router's ring plus N replica rings into one
+Perfetto timeline on a shared clock), ``FlightRecorder`` the bounded
+postmortem ring that auto-dumps on degradation triggers,
+``MetricsRegistry`` the named-snapshot surface unifying the
+per-subsystem Stats dataclasses (metrics.py) with pool occupancy and
+live-HBM gauges, exportable as Prometheus textfiles and JSONL time
+series, and ``SLOMonitor`` (obs/slo.py) the per-priority-class TTFT/ITL
+objective judge emitting typed ``slo_breach`` events off windowed burn
+rates.
 """
 
 from orion_tpu.obs.flight import FlightRecorder, init_obs
@@ -16,11 +22,15 @@ from orion_tpu.obs.registry import (
     bench_metrics_block,
     live_hbm_metrics,
 )
+from orion_tpu.obs.slo import SLOMonitor, SLOObjective, build_objectives
 from orion_tpu.obs.trace import (
     NULL_TRACER,
     NullTracer,
     Tracer,
     export_chrome_safe,
+    merge_chrome,
+    merge_chrome_safe,
+    namespaced_path,
 )
 
 __all__ = [
@@ -28,9 +38,15 @@ __all__ = [
     "MetricsRegistry",
     "NULL_TRACER",
     "NullTracer",
+    "SLOMonitor",
+    "SLOObjective",
     "Tracer",
     "bench_metrics_block",
+    "build_objectives",
     "export_chrome_safe",
     "init_obs",
     "live_hbm_metrics",
+    "merge_chrome",
+    "merge_chrome_safe",
+    "namespaced_path",
 ]

@@ -20,7 +20,12 @@ constraints, in order:
 
 Export is Chrome trace-event JSON (``export_chrome``), loadable in
 Perfetto / ``chrome://tracing``; timestamps are microseconds relative to
-tracer construction.
+tracer construction. ``merge_chrome`` (ISSUE 14) merges N tracers —
+the router's plus one per replica engine — into ONE timeline on a
+shared clock: ring events carry absolute ``time.monotonic()`` stamps,
+so reconciling per-tracer construction offsets is a single re-base
+against the earliest tracer, and each source becomes its own Perfetto
+process (``pid`` + ``process_name`` metadata).
 """
 
 from __future__ import annotations
@@ -61,6 +66,8 @@ class NullTracer:
     a no-op ``with`` per dispatch."""
 
     enabled = False
+    dropped = 0
+    capacity = 0
 
     def span(self, name: str, annotate: bool = False, **tags) -> _NullCtx:
         return _NULL_CTX
@@ -120,7 +127,7 @@ class _Span:
         self.t1 = time.monotonic()
         if self._ann is not None:
             self._ann.__exit__(*exc)
-        self._tracer._ring.append(
+        self._tracer._append(
             ("span", self.name, self.t0, self.t1, self.tags)
         )
         return False
@@ -136,6 +143,10 @@ class Tracer:
     Thread-notes: ``deque.append`` is atomic under the GIL and the
     watchdog/async-checkpoint threads only ever ``instant()``, so no lock
     is needed on the hot path; ``events()`` snapshots with ``list()``.
+    The ``dropped`` overflow counter's check-then-append pair is not
+    atomic, so concurrent appends at the ring boundary can undercount by
+    a few — acceptable for a truncation FLAG (zero stays exactly zero:
+    no append ever drops before the ring is full).
     """
 
     enabled = True
@@ -146,8 +157,19 @@ class Tracer:
         self.capacity = capacity
         self._ring: deque[Event] = deque(maxlen=capacity)
         self.t0 = time.monotonic()
+        # Ring-overflow accounting (ISSUE 14 satellite): a deque(maxlen)
+        # silently evicts the oldest event on overflow, which means a
+        # long run's export is a TRUNCATED timeline — count evictions so
+        # the registry can gauge it and obs_report can flag the export
+        # instead of rendering a hole as if nothing happened.
+        self.dropped = 0
 
     # -- recording ---------------------------------------------------------
+
+    def _append(self, event: Event) -> None:
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        self._ring.append(event)
 
     def span(self, name: str, annotate: bool = False, **tags) -> _Span:
         """Context manager recording a [enter, exit) span. With
@@ -158,14 +180,14 @@ class Tracer:
 
     def instant(self, name: str, **tags) -> None:
         t = time.monotonic()
-        self._ring.append(("instant", name, t, t, tags))
+        self._append(("instant", name, t, t, tags))
 
     def record_span(self, name: str, t_start: float, t_end: float,
                     **tags) -> None:
         """Append an already-measured span (times on the time.monotonic
         clock) — for call sites that cannot wrap their body in a ``with``
         without restructuring (e.g. the engine's whole-step span)."""
-        self._ring.append(("span", name, t_start, t_end, tags))
+        self._append(("span", name, t_start, t_end, tags))
 
     def annotation(self, name: str):
         """Bare ``jax.profiler.TraceAnnotation`` context (device-profile
@@ -186,47 +208,165 @@ class Tracer:
 
     def clear(self) -> None:
         self._ring.clear()
+        self.dropped = 0
+
+    def metrics(self) -> dict[str, int]:
+        """Ring gauges for the metrics registry ("trace" section): event
+        count, capacity and the overflow-drop counter — a nonzero
+        ``dropped`` means any export from this ring is a truncated
+        timeline."""
+        return {
+            "events": len(self._ring),
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+        }
 
     def export_chrome(self, path: str) -> int:
         """Write the ring as Chrome trace-event JSON (Perfetto /
         chrome://tracing loadable); returns the number of events written.
         Spans are "X" (complete) events, instants "i"; ``ts``/``dur`` are
         microseconds relative to tracer construction; tags ride ``args``.
+        The top-level ``metadata`` block carries the monotonic clock base
+        (so merged/compared exports can reconcile offsets) and the
+        ring-overflow drop count (so consumers can flag truncation).
         """
         evs: list[dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
              "args": {"name": "orion-tpu host"}},
         ]
-        base = self.t0
-        for kind, name, t_start, t_end, tags in self.events():
-            ev: dict[str, Any] = {
-                "name": name,
-                "ts": (t_start - base) * 1e6,
-                "pid": 0,
-                "tid": 0,
-                "args": dict(tags),
-            }
-            if kind == "span":
-                ev["ph"] = "X"
-                ev["dur"] = (t_end - t_start) * 1e6
-            else:
-                ev["ph"] = "i"
-                ev["s"] = "t"
-            evs.append(ev)
-        # tmp + atomic rename, like every other obs artifact writer: a
-        # poller watching trace_path (or a mid-write crash) must never see
-        # a torn multi-MB JSON. default=str: a non-primitive tag value
-        # degrades to its repr, never TypeErrors a shutdown-path export.
-        import os
-
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {"traceEvents": evs, "displayTimeUnit": "ms"}, f,
-                default=str,
-            )
-        os.replace(tmp, path)
+        evs.extend(_chrome_events(self.events(), self.t0, pid=0))
+        meta = {
+            "clock_base_monotonic_s": self.t0,
+            "dropped_events": self.dropped,
+            "ring_capacity": self.capacity,
+        }
+        _write_chrome(path, evs, meta)
         return len(evs) - 1  # metadata event excluded
+
+
+def _chrome_events(
+    events: list[Event], base: float, pid: int
+) -> list[dict[str, Any]]:
+    """Ring events as Chrome trace-event dicts: ``ts``/``dur`` in
+    microseconds re-based against ``base`` (a monotonic-clock origin),
+    under process id ``pid``. Shared by the single-tracer export and the
+    multi-source merge, so both emit identical event shapes."""
+    out: list[dict[str, Any]] = []
+    for kind, name, t_start, t_end, tags in events:
+        ev: dict[str, Any] = {
+            "name": name,
+            "ts": (t_start - base) * 1e6,
+            "pid": pid,
+            "tid": 0,
+            "args": dict(tags),
+        }
+        if kind == "span":
+            ev["ph"] = "X"
+            ev["dur"] = (t_end - t_start) * 1e6
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        out.append(ev)
+    return out
+
+
+def _write_chrome(path: str, evs: list, meta: dict) -> None:
+    # tmp + atomic rename, like every other obs artifact writer: a
+    # poller watching trace_path (or a mid-write crash) must never see
+    # a torn multi-MB JSON. default=str: a non-primitive tag value
+    # degrades to its repr, never TypeErrors a shutdown-path export.
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"traceEvents": evs, "displayTimeUnit": "ms",
+             "metadata": meta},
+            f, default=str,
+        )
+    os.replace(tmp, path)
+
+
+def merge_chrome(
+    path: str, sources: list[tuple[str, Any]]
+) -> int:
+    """Merge N tracers into ONE Perfetto timeline (ISSUE 14 tentpole):
+    ``sources`` is ``[(name, tracer)]`` — e.g. the router's tracer plus
+    one per replica engine. Each source becomes its own Perfetto process
+    (``pid`` = source index, ``process_name``/``thread_name`` metadata =
+    the source name); every event is re-based onto the SHARED clock (the
+    earliest tracer's construction origin — ring events carry absolute
+    ``time.monotonic()`` stamps, so per-tracer offsets reconcile by
+    subtraction, no cross-process clock sync needed for in-process
+    replicas). Disabled (Null) tracers contribute an empty process, so
+    the process list always names the whole fleet. Returns the number of
+    events written (metadata rows excluded); the top-level ``metadata``
+    block carries per-process event/drop counts so a truncated replica
+    ring is visible in the artifact itself."""
+    enabled = [tr for _, tr in sources if tr.enabled]
+    base = min((tr.t0 for tr in enabled), default=0.0)
+    evs: list[dict[str, Any]] = []
+    procs: dict[str, Any] = {}
+    total = 0
+    for pid, (name, tr) in enumerate(sources):
+        evs.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": name}})
+        evs.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": name}})
+        rows = _chrome_events(tr.events(), base, pid=pid)
+        evs.extend(rows)
+        total += len(rows)
+        procs[name] = {
+            "pid": pid,
+            "events": len(rows),
+            "dropped": tr.dropped,
+            "clock_offset_us": (
+                (tr.t0 - base) * 1e6 if tr.enabled else None
+            ),
+        }
+    meta = {
+        "merged": True,
+        "clock_base_monotonic_s": base,
+        "dropped_events": sum(tr.dropped for _, tr in sources),
+        "processes": procs,
+    }
+    _write_chrome(path, evs, meta)
+    return total
+
+
+def merge_chrome_safe(
+    path: Optional[str], sources: list[tuple[str, Any]]
+) -> int:
+    """``merge_chrome`` under the shared shutdown-path error contract
+    (the fleet analog of ``export_chrome_safe``): no-op when no path is
+    configured or every source is disabled; a write failure is logged,
+    never raised. Returns events written."""
+    import logging
+
+    log = logging.getLogger("orion_tpu.obs")
+    if not path or not any(tr.enabled for _, tr in sources):
+        return 0
+    try:
+        n = merge_chrome(path, sources)
+        log.info(
+            "merged %d trace events from %d processes to %s "
+            "(load in Perfetto)", n, len(sources), path,
+        )
+        return n
+    except OSError as e:
+        log.error("merged trace export to %s failed: %s", path, e)
+        return 0
+
+
+def namespaced_path(path: str, tag: str) -> str:
+    """Per-replica sink path: insert ``tag`` before the extension —
+    ``("/tmp/trace.json", "replica-0")`` -> ``/tmp/trace.replica-0.json``
+    — so N replicas exporting the "same" configured target never clobber
+    one file (ISSUE 14; PR 11 stripped replica targets instead)."""
+    import os
+
+    root, ext = os.path.splitext(path)
+    return f"{root}.{tag}{ext}" if ext else f"{path}.{tag}"
 
 
 def export_chrome_safe(tracer, path: Optional[str]) -> int:

@@ -419,6 +419,127 @@ def test_obs_report_renders_trace_and_dump(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# Ring overflow accounting + merged fleet export (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_overflow_counted_and_exported(tmp_path):
+    """Ring overflow is no longer silent: dropped events are counted,
+    surface in the registry-style metrics() gauges and in the export's
+    metadata block, and clear() resets them with the ring."""
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        tr.instant(f"e{i}")
+    assert tr.dropped == 6
+    assert tr.metrics() == {"events": 4, "capacity": 4, "dropped": 6}
+    path = tmp_path / "t.json"
+    tr.export_chrome(str(path))
+    meta = json.loads(path.read_text())["metadata"]
+    assert meta["dropped_events"] == 6
+    assert meta["ring_capacity"] == 4
+    assert "clock_base_monotonic_s" in meta
+    tr.clear()
+    assert tr.dropped == 0 and tr.events() == []
+    # Refilling below capacity drops nothing.
+    tr.instant("x")
+    assert tr.dropped == 0
+
+
+def test_engine_trace_registry_section(tmp_path):
+    """The engine registers the trace-ring gauges only when tracing is
+    on — the obs-off snapshot keys (and thus the Prometheus row set)
+    are unchanged."""
+    eng, params = make_engine(["inference.trace=true",
+                               "inference.trace_ring=8"])
+    eng.generate([[1, 2, 3]], 4)
+    snap = eng.registry.snapshot(sections=("trace",))
+    assert snap["trace.capacity"] == 8
+    assert snap["trace.dropped"] > 0      # tiny ring overflowed
+    eng.close()
+    off, _ = make_engine(params=params)
+    assert "trace" not in off.registry.sections()
+    off.close()
+
+
+def test_merge_chrome_shared_clock(tmp_path):
+    """merge_chrome: one process per source, events re-based onto the
+    EARLIEST tracer's clock (per-process monotonic offsets reconciled),
+    process_name metadata per pid, per-process drop counts in the
+    metadata block; a NullTracer source contributes an empty process."""
+    import time as _time
+
+    from orion_tpu.obs import merge_chrome
+
+    t1 = Tracer()
+    t1.instant("a", rid=1)
+    _time.sleep(0.02)
+    t2 = Tracer()                 # constructed later: positive offset
+    t2.instant("b", rid=2)
+    path = tmp_path / "merged.json"
+    n = merge_chrome(str(path), [
+        ("router", t1), ("replica-0", t2), ("replica-1", NULL_TRACER),
+    ])
+    assert n == 2
+    doc = json.loads(path.read_text())
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert procs == {0: "router", 1: "replica-0", 2: "replica-1"}
+    evs = {
+        e["name"]: e for e in doc["traceEvents"] if e["ph"] == "i"
+    }
+    # Shared clock: t2's event happened AFTER t1's on the merged axis,
+    # even though both are "early" relative to their own tracer's t0.
+    assert evs["b"]["ts"] > evs["a"]["ts"]
+    assert evs["a"]["pid"] == 0 and evs["b"]["pid"] == 1
+    meta = doc["metadata"]
+    assert meta["merged"] is True
+    assert meta["processes"]["replica-0"]["clock_offset_us"] > 0
+    assert meta["processes"]["replica-1"]["events"] == 0
+
+
+def test_obs_report_flags_truncation_and_fleet(tmp_path, capsys):
+    """obs_report on a merged trace: flags ring truncation instead of
+    rendering a hole, renders the per-process share table, the fleet
+    event timeline, correlated request tracks, and the SLO burn panel."""
+    import tools.obs_report as obs_report
+
+    from orion_tpu.obs import merge_chrome
+
+    rt = Tracer(capacity=4)       # will overflow -> truncation flag
+    for i in range(6):
+        rt.instant("route", rid=i, tid=i, replica=0)
+    rt.instant("retry", rid=5, tid=5, attempt=1, backoff_steps=1,
+               reason="replica 0: killed")
+    rt.instant("slo_breach", objective="itl_all", burn=3.2, events=10,
+               worst_ms=410.0, target_ms=50.0, goal=0.9)
+    rt.instant("outcome", rid=5, tid=5, outcome="completed", retried=1)
+    rep = Tracer()
+    with rep.span("dispatch/decode", step=0):
+        pass
+    rep.instant("admit", rid=0, tid=5, retried=1, slot=0)
+    path = tmp_path / "merged.json"
+    merge_chrome(str(path), [("router", rt), ("replica-0", rep)])
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "merged fleet trace" in out
+    assert "TRUNCATED TIMELINE" in out and "dropped" in out
+    assert "per-process span shares" in out
+    assert "fleet events" in out and "slo_breach" in out
+    assert "request tracks" in out
+    assert "retry1" in out            # the retried hop is tagged
+    assert "SLO burn panel" in out and "itl_all" in out
+    # A plain single-process trace renders WITHOUT the fleet sections.
+    solo = tmp_path / "solo.json"
+    rep.export_chrome(str(solo))
+    assert obs_report.main([str(solo)]) == 0
+    out = capsys.readouterr().out
+    assert "merged" not in out and "per-process span shares" not in out
+
+
+# ---------------------------------------------------------------------------
 # Trainer tracing + rollback trigger
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,170 @@
+"""SLO monitor units (obs/slo.py; ISSUE 14): objective parsing from
+config, windowed burn-rate math, breach/no-breach windows, empty-class
+edges, forced final sweep, and the registry metrics surface. All pure
+host-side — the router-integration pins (slo_breach under an injected
+replica_stall, zero breaches on the uncontended smoke) live in
+tests/test_router.py / tools/router_bench.py --smoke.
+"""
+
+import pytest
+
+from orion_tpu.config import SLOConfig, parse_per_class
+from orion_tpu.obs import SLOMonitor, SLOObjective, build_objectives
+
+
+# ---------------------------------------------------------------------------
+# Config: per-class spec grammar + SLOConfig validation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_per_class_grammar():
+    assert parse_per_class("") == {}
+    assert parse_per_class("2:ttft=200") == {2: {"ttft": 200.0}}
+    assert parse_per_class("2:ttft=200,itl=40;0:ttft=1000") == {
+        2: {"ttft": 200.0, "itl": 40.0},
+        0: {"ttft": 1000.0},
+    }
+    # Negative classes and whitespace tolerated.
+    assert parse_per_class(" -1 : itl = 5 ") == {-1: {"itl": 5.0}}
+
+
+@pytest.mark.parametrize("bad", [
+    "2",                    # no targets
+    "x:ttft=1",             # non-int class
+    "2:latency=5",          # unknown metric
+    "2:ttft=abc",           # non-numeric target
+    "2:ttft=0",             # non-positive target
+    "2:ttft=1;2:itl=2",     # repeated class
+])
+def test_parse_per_class_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_per_class(bad)
+
+
+def test_slo_config_validation():
+    assert not SLOConfig().enabled
+    assert SLOConfig(ttft_ms=100).enabled
+    assert SLOConfig(per_class="1:itl=5").enabled
+    with pytest.raises(ValueError):
+        SLOConfig(ttft_ms=0)
+    with pytest.raises(ValueError):
+        SLOConfig(goal=1.0)      # no budget left to burn
+    with pytest.raises(ValueError):
+        SLOConfig(window_s=0)
+    with pytest.raises(ValueError):
+        SLOConfig(min_events=0)
+    with pytest.raises(ValueError):
+        SLOConfig(per_class="2:nope=1")
+
+
+def test_build_objectives_from_config():
+    cfg = SLOConfig(ttft_ms=100, per_class="2:ttft=50,itl=10", goal=0.95)
+    objs = build_objectives(cfg)
+    assert sorted(o.key for o in objs) == ["itl_c2", "ttft_all", "ttft_c2"]
+    assert all(o.goal == 0.95 for o in objs)
+    by_key = {o.key: o for o in objs}
+    assert by_key["ttft_c2"].target_s == 0.05
+    assert by_key["ttft_all"].cls is None
+    # No objectives configured -> no monitor at all.
+    assert SLOMonitor.from_config(SLOConfig()) is None
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math: breach / no-breach / empty-class windows
+# ---------------------------------------------------------------------------
+
+
+def _monitor(**kw):
+    kw.setdefault("window_s", 1.0)
+    return SLOMonitor(
+        [SLOObjective("ttft", 0.100, goal=0.9),
+         SLOObjective("itl", 0.010, cls=2, goal=0.9)], **kw,
+    )
+
+
+def test_no_breach_window():
+    m = _monitor()
+    for _ in range(10):
+        m.observe("ttft", 0, 0.050, now=0.0)   # all meet the 100ms target
+    assert m.sweep(0.5) == []                  # window not elapsed yet
+    assert m.sweep(1.5) == []                  # elapsed: judged, no breach
+    assert m.windows == 1 and m.breaches == 0
+    assert m.last_burn["ttft_all"] == 0.0
+
+
+def test_breach_window_burn_math():
+    m = _monitor()
+    # 10 events, 3 violations, goal 0.9 -> burn = 0.3 / 0.1 = 3.0.
+    for v in [0.05] * 7 + [0.2] * 3:
+        m.observe("ttft", 0, v, now=0.0)
+    fired = []
+    m.on_breach = fired.append
+    breaches = m.sweep(2.0)
+    assert len(breaches) == 1 and breaches == fired
+    b = breaches[0]
+    assert b["objective"] == "ttft_all"
+    assert b["burn"] == pytest.approx(3.0)
+    assert b["events"] == 10 and b["violations"] == 3
+    assert b["worst_ms"] == pytest.approx(200.0)
+    assert m.breaches == 1
+    assert m.last_burn["ttft_all"] == pytest.approx(3.0)
+    # The window closed: a later sweep with no new events judges nothing.
+    assert m.sweep(5.0) == []
+    assert m.windows == 1
+
+
+def test_empty_class_window_never_breaches():
+    """An objective for class 2 with ZERO class-2 events in the window:
+    no evidence, no verdict — and no division by zero. Class-0 traffic
+    violating wildly must not leak into the class-2 objective."""
+    m = _monitor()
+    for _ in range(5):
+        m.observe("itl", 0, 9.9, now=0.0)      # class 0, not judged vs c2
+    breaches = m.sweep(2.0)
+    assert all(b["objective"] != "itl_c2" for b in breaches)
+    assert m.last_burn["itl_c2"] == 0.0
+    # A fleet-wide objective DOES see every class.
+    m2 = SLOMonitor([SLOObjective("itl", 0.010, goal=0.9)], window_s=1.0)
+    m2.observe("itl", 0, 9.9, now=0.0)
+    assert m2.sweep(2.0)[0]["objective"] == "itl_all"
+
+
+def test_min_events_gate():
+    m = _monitor(min_events=5)
+    for _ in range(4):
+        m.observe("ttft", 0, 9.9, now=0.0)     # all violating, but thin
+    assert m.sweep(2.0) == []                  # too thin to judge
+    assert m.windows == 1                      # window still consumed
+
+
+def test_idle_monitor_never_judged():
+    m = _monitor()
+    assert m.sweep(100.0) == []                # no window ever opened
+    assert m.windows == 0
+
+
+def test_forced_final_sweep_judges_partial_window():
+    """The shutdown path's force=True judges a window younger than
+    window_s — a serve shorter than the window still gets one verdict."""
+    m = _monitor()
+    m.observe("ttft", 0, 0.5, now=0.0)
+    assert m.sweep(0.1) == []                  # too young
+    breaches = m.sweep(0.1, force=True)
+    assert len(breaches) == 1 and m.windows == 1
+
+
+def test_metrics_surface():
+    m = _monitor()
+    for v in (0.005, 0.020):
+        m.observe("itl", 2, v, now=0.0)
+    m.observe("ttft", 0, 0.05, now=0.0)
+    m.sweep(2.0)
+    g = m.metrics()
+    assert g["windows"] == 1 and g["objectives"] == 2
+    # itl_c2: 1 of 2 violated, goal 0.9 -> burn 5.0; breach counted.
+    assert g["burn_itl_c2"] == pytest.approx(5.0)
+    assert g["breaches"] == 1
+    # Last-window per-class percentiles ride the same section.
+    assert g["itl_c2_count"] == 2
+    assert g["itl_c2_p99_ms"] == pytest.approx(20.0)
+    assert g["ttft_c0_count"] == 1

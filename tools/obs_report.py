@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Render a tracer export / flight-recorder dump as a terminal timeline
-summary (ISSUE 9 satellite; the serving-side companion of
+summary (ISSUEs 9 + 14; the serving-side companion of
 profile_report.py).
 
-Accepts either artifact the obs layer writes:
+Accepts any artifact the obs layer writes:
 
   - a Chrome trace-event JSON (``inference.trace_path`` /
-    ``train.trace_path`` / ``engine.export_trace``), or
+    ``train.trace_path`` / ``engine.export_trace``),
+  - a MERGED fleet trace (``Router.close()`` / ``Router.export_trace``:
+    one process per source — router + replica-k), or
   - a flight-recorder dump (``inference.flight_dir`` /
     ``train.flight_dir`` auto-dumps on degradation triggers).
 
@@ -14,9 +16,15 @@ Reports: span groups by total time (the slowest-spans table), the top
 individual spans, a per-request TTFT breakdown (submit -> admit queue
 wait vs admit -> first-token compute, from the lifecycle instants), and —
 for flight dumps — the fault-adjacent event window that explains why the
-dump exists.
+dump exists. Merged traces additionally get the FLEET view: per-replica
+span-share diff, the breaker/failover event timeline, per-request
+correlated tracks (one request's journey across router + replicas, keyed
+on the ``tid`` trace id), and the SLO burn panel. A trace whose ring
+overflowed (``metadata.dropped_events`` > 0) is flagged as TRUNCATED
+instead of silently rendering a hole.
 
     python tools/obs_report.py /tmp/serve_trace.json
+    python tools/obs_report.py /tmp/fleet/trace.json        # merged
     python tools/obs_report.py /tmp/flight/flight_nan_quarantine_*.json
     python tools/obs_report.py --compare base_trace.json new_trace.json
 """
@@ -30,36 +38,61 @@ import sys
 
 
 def load(path: str):
-    """Normalize either artifact into (spans, instants, meta):
-    spans [(name, t_start_s, dur_s, tags)], instants [(name, t_s, tags)],
-    meta {} for traces / the dump header for flight dumps."""
+    """Normalize either artifact into (spans, instants, meta, procs):
+    spans [(name, t_start_s, dur_s, tags, pid)], instants
+    [(name, t_s, tags, pid)], meta {} for plain traces / the dump header
+    for flight dumps / the export metadata for traces that carry it,
+    procs {pid: process_name} from the trace's metadata events."""
     with open(path) as f:
         doc = json.load(f)
     spans, instants = [], []
+    procs: dict[int, str] = {}
     if isinstance(doc, dict) and "spans" in doc and "reason" in doc:
         # Flight-recorder dump: times are monotonic seconds.
         for e in doc["spans"]:
             tags = e.get("tags", {})
             if e["kind"] == "span":
                 spans.append(
-                    (e["name"], e["t_start"], e["t_end"] - e["t_start"], tags)
+                    (e["name"], e["t_start"], e["t_end"] - e["t_start"],
+                     tags, 0)
                 )
             else:
-                instants.append((e["name"], e["t_start"], tags))
+                instants.append((e["name"], e["t_start"], tags, 0))
         meta = {k: doc.get(k) for k in
                 ("reason", "wall_time", "context", "events", "metrics")}
-        return spans, instants, meta
+        return spans, instants, meta, procs
     events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    meta = doc.get("metadata", {}) if isinstance(doc, dict) else {}
     for e in events:
         ph = e.get("ph")
         tags = e.get("args", {})
-        if ph == "X":
+        pid = e.get("pid", 0)
+        if ph == "M":
+            if e.get("name") == "process_name":
+                procs[pid] = tags.get("name", f"pid{pid}")
+        elif ph == "X":
             spans.append(
-                (e["name"], e["ts"] / 1e6, e.get("dur", 0) / 1e6, tags)
+                (e["name"], e["ts"] / 1e6, e.get("dur", 0) / 1e6, tags,
+                 pid)
             )
         elif ph == "i":
-            instants.append((e["name"], e["ts"] / 1e6, tags))
-    return spans, instants, {}
+            instants.append((e["name"], e["ts"] / 1e6, tags, pid))
+    return spans, instants, meta, procs
+
+
+def print_truncation(meta, procs) -> None:
+    """Flag a ring-overflow-truncated timeline (ISSUE 14 satellite): the
+    export is the most recent window only, and every absence before its
+    first event means 'evicted', not 'did not happen'."""
+    dropped = meta.get("dropped_events") or 0
+    if not dropped:
+        return
+    print(f"  *** TRUNCATED TIMELINE: {dropped} events dropped by ring "
+          f"overflow (raise trace_ring) — earliest activity is missing,"
+          f" not absent ***")
+    for name, p in (meta.get("processes") or {}).items():
+        if p.get("dropped"):
+            print(f"      {name}: {p['dropped']} dropped")
 
 
 def group_spans(spans):
@@ -67,7 +100,7 @@ def group_spans(spans):
     groups: dict = collections.defaultdict(
         lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0}
     )
-    for name, _t, dur, _tags in spans:
+    for name, _t, dur, _tags, _pid in spans:
         g = groups[name]
         g["count"] += 1
         g["total_s"] += dur
@@ -91,7 +124,7 @@ def print_groups(groups, top: int) -> None:
 
 def print_slowest(spans, top: int) -> None:
     print(f"\nslowest {min(top, len(spans))} individual spans:")
-    for name, t, dur, tags in sorted(
+    for name, t, dur, tags, _pid in sorted(
         spans, key=lambda s: s[2], reverse=True
     )[:top]:
         extra = " ".join(
@@ -104,7 +137,7 @@ def ttft_breakdown(instants, top: int) -> None:
     """Per-request lifecycle: submit -> admit (queue wait) -> first_token
     (prefill/compute) -> outcome, from the engine's lifecycle instants."""
     by_rid: dict = collections.defaultdict(dict)
-    for name, t, tags in instants:
+    for name, t, tags, _pid in instants:
         rid = tags.get("rid")
         if rid is None:
             continue
@@ -137,6 +170,153 @@ def ttft_breakdown(instants, top: int) -> None:
               f" {ev.get('outcome', '(live)')}")
 
 
+# ---------------------------------------------------------------------------
+# Fleet view (merged traces; ISSUE 14)
+# ---------------------------------------------------------------------------
+
+FLEET_EVENTS = ("break", "probe", "recover", "retry", "slo_breach")
+
+
+def print_fleet_shares(spans, procs, top: int) -> None:
+    """Per-replica span-share diff: one column per process, rows = span
+    groups ranked by fleet-total time — where each replica's time went,
+    side by side (a replica grinding 80% verify while its peers decode
+    is visible in one glance)."""
+    pids = sorted(procs)
+    per: dict[int, dict] = {
+        pid: collections.defaultdict(float) for pid in pids
+    }
+    totals: dict[int, float] = {pid: 0.0 for pid in pids}
+    fleet: dict = collections.defaultdict(float)
+    for name, _t, dur, _tags, pid in spans:
+        if pid not in per:
+            continue
+        per[pid][name] += dur
+        totals[pid] += dur
+        fleet[name] += dur
+    cols = [procs[pid][:12] for pid in pids]
+    print("\nper-process span shares (fleet diff):")
+    print(f"{'span group':<24s} " +
+          " ".join(f"{c:>12s}" for c in cols))
+    ranked = sorted(fleet.items(), key=lambda kv: kv[1], reverse=True)
+    for name, _total in ranked[:top]:
+        cells = []
+        for pid in pids:
+            t = totals[pid]
+            share = per[pid][name] / t * 100 if t > 0 else 0.0
+            cells.append(f"{share:>11.1f}%" if per[pid][name] else
+                         f"{'-':>12s}")
+        print(f"{name:<24s} " + " ".join(cells))
+    print(f"{'total span time':<24s} " + " ".join(
+        f"{totals[pid] * 1e3:>10.1f}ms" for pid in pids
+    ))
+
+
+def print_fleet_timeline(instants, procs, tail: int) -> None:
+    """Breaker state transitions, failover re-queues and SLO breaches in
+    one time-ordered stream — the fleet's incident log, drawn from the
+    same instants the request tracks carry."""
+    rows = [
+        (t, name, tags, pid) for name, t, tags, pid in instants
+        if name in FLEET_EVENTS
+    ]
+    if not rows:
+        return
+    t0 = min(t for _n, t, _tg, _p in instants) if instants else 0.0
+    print(f"\nfleet events ({len(rows)}; breaker/failover/SLO):")
+    # Sort on time only: a timestamp tie must not fall through to dict
+    # comparison (tags) and TypeError a report.
+    for t, name, tags, pid in sorted(rows, key=lambda r: r[0])[-tail:]:
+        if name == "retry":
+            detail = (f"rid={tags.get('rid')} attempt={tags.get('attempt')}"
+                      f" backoff={tags.get('backoff_steps')} "
+                      f"({str(tags.get('reason', ''))[:40]})")
+        elif name == "slo_breach":
+            detail = (f"{tags.get('objective')} burn={tags.get('burn')} "
+                      f"events={tags.get('events')} "
+                      f"worst={tags.get('worst_ms')}ms")
+        else:
+            detail = " ".join(
+                f"{k}={v}" for k, v in tags.items()
+                if k in ("replica", "reason", "killed")
+            )
+        print(f"  +{(t - t0) * 1e3:>9.1f}ms  {name:<12s} "
+              f"[{procs.get(pid, pid)}]  {detail}")
+
+
+def print_request_tracks(instants, procs, top: int) -> None:
+    """Correlated per-request tracks: every lifecycle/routing instant
+    carrying the same ``tid`` trace id, across ALL processes, rendered
+    as one journey line — a failover reads route -> admit -> retry ->
+    route -> ... -> outcome with the replica names inline."""
+    by_tid: dict = collections.defaultdict(list)
+    for name, t, tags, pid in instants:
+        tid = tags.get("tid")
+        if tid is None:
+            continue
+        by_tid[tid].append((t, name, tags, pid))
+    if not by_tid:
+        return
+    # Failover'd (retried) tracks first — they are what a postmortem
+    # reads — then by event count.
+    def key(item):
+        tid, evs = item
+        retried = max(
+            (tg.get("retried", 0) or 0) for _t, _n, tg, _p in evs
+        )
+        return (-retried, -len(evs), tid)
+
+    ranked = sorted(by_tid.items(), key=key)
+    print(f"\nrequest tracks ({len(by_tid)} correlated tids; "
+          f"retried first):")
+    for tid, evs in ranked[:top]:
+        evs.sort(key=lambda e: e[0])   # time only — tags are dicts
+        t0 = evs[0][0]
+        hops = []
+        for t, name, tags, pid in evs:
+            where = procs.get(pid, str(pid))
+            label = name
+            if name == "route":
+                label = f"route->r{tags.get('replica')}"
+            elif name == "outcome":
+                label = f"outcome={tags.get('outcome')}"
+            if tags.get("retried"):
+                label += f"(retry{tags['retried']})"
+            hops.append(f"{label}@{where}+{(t - t0) * 1e3:.0f}ms")
+        print(f"  tid {tid}: " + " -> ".join(hops))
+
+
+def print_slo_panel(instants, meta) -> None:
+    """SLO burn panel: breach instants from the timeline (the router
+    emits one per judged-over-budget window) or, for flight dumps, the
+    slo.* gauges in the metrics snapshot."""
+    breaches = [
+        (t, tags) for name, t, tags, _pid in instants
+        if name == "slo_breach"
+    ]
+    gauges = {
+        k: v for k, v in (meta.get("metrics") or {}).items()
+        if k.startswith("slo.")
+    }
+    if not breaches and not gauges:
+        return
+    print("\nSLO burn panel:")
+    if breaches:
+        by_obj: dict = collections.defaultdict(list)
+        for _t, tags in breaches:
+            by_obj[tags.get("objective", "?")].append(tags)
+        for obj, rows in sorted(by_obj.items()):
+            worst = max(float(r.get("burn", 0) or 0) for r in rows)
+            print(f"  {obj:<16s} breaches={len(rows)} "
+                  f"worst_burn={worst:.2f}x "
+                  f"(target {rows[-1].get('target_ms')}ms, "
+                  f"goal {rows[-1].get('goal')})")
+    else:
+        print("  no slo_breach events in this window")
+    for k in sorted(gauges):
+        print(f"  {k} = {gauges[k]}")
+
+
 def print_fault_window(meta, tail: int = 12) -> None:
     print(f"\nflight dump: reason={meta['reason']} at {meta['wall_time']}")
     if meta.get("context"):
@@ -152,7 +332,8 @@ def print_fault_window(meta, tail: int = 12) -> None:
     faults = {
         k: v for k, v in metrics.items()
         if any(s in k for s in ("fault", "failed", "stalled", "quarantined",
-                                "shed", "expired", "rollback", "anomalous"))
+                                "shed", "expired", "rollback", "anomalous",
+                                "breach"))
         and v not in (0, 0.0, "")
     }
     if faults:
@@ -186,7 +367,8 @@ def compare(path_a: str, path_b: str, top: int) -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("paths", nargs="+",
-                    help="trace JSON or flight dump (2 with --compare)")
+                    help="trace JSON (plain or merged) or flight dump "
+                         "(2 with --compare)")
     ap.add_argument("--compare", action="store_true",
                     help="diff span shares between two artifacts")
     ap.add_argument("--top", type=int, default=15)
@@ -200,15 +382,33 @@ def main(argv=None) -> int:
     if len(args.paths) != 1:
         print("one artifact at a time (or --compare A B)", file=sys.stderr)
         return 2
-    spans, instants, meta = load(args.paths[0])
-    print(f"{args.paths[0]}: {len(spans)} spans, {len(instants)} instants")
-    if meta:
+    spans, instants, meta, procs = load(args.paths[0])
+    fleet = len(procs) > 1
+    kind = "merged fleet trace" if fleet else "trace"
+    print(f"{args.paths[0]}: {kind}, {len(spans)} spans, "
+          f"{len(instants)} instants"
+          + (f", {len(procs)} processes "
+             f"({', '.join(procs[p] for p in sorted(procs))})"
+             if fleet else ""))
+    print_truncation(meta, procs)
+    if meta.get("reason"):
         print_fault_window(meta)
     if spans:
         print("\nspan groups by total time:")
         print_groups(group_spans(spans), args.top)
         print_slowest(spans, min(args.top, 10))
-    ttft_breakdown(instants, args.top)
+    if fleet:
+        print_fleet_shares(spans, procs, args.top)
+        print_fleet_timeline(instants, procs, tail=2 * args.top)
+        print_request_tracks(instants, procs, args.top)
+        print_slo_panel(instants, meta)
+    else:
+        ttft_breakdown(instants, args.top)
+        if meta.get("reason"):
+            # Flight dumps carry the tracer window (which may hold
+            # slo_breach instants) and the registry snapshot's slo.*
+            # gauges — render the burn panel for them too.
+            print_slo_panel(instants, meta)
     return 0
 
 

@@ -18,17 +18,30 @@ is exercised, not just round-robin). Two runs:
 
 Reported per mode (one JSON line each): outcome counts (aggregate and
 per-replica via ``obs.bench_metrics_block``), throughput/recovery, router
-decision counters (routed/affinity/retries/breaks), TTFT/ITL. A final
-JSON verdict line carries the chaos-pin booleans; ``--smoke`` (tier-1
-wiring, tests/test_router.py) asserts them.
+decision counters (routed/affinity/retries/breaks), TTFT/ITL, SLO burn
+gauges. A final JSON verdict line carries the chaos-pin booleans;
+``--smoke`` (tier-1 wiring, tests/test_router.py) asserts them.
+
+Fleet obs pins (ISSUE 14): the chaos run records the fleet timeline and
+``Router.close()`` writes the MERGED trace; the verdict asserts it
+exists, parses, carries >= 1 span for the router-plus-every-replica
+process set, rid-correlates each request's lifecycle (exactly one router
+outcome instant per rid; failover'd rids present on >= 2 replica tracks
+with the ``retried`` tag), and that the uncontended baseline run judged
+>= 1 SLO window with ZERO breaches. ``--trace`` additionally turns the
+tracer on for the BASELINE run: its wall_s/tokens_per_step against a
+plain ``--smoke`` run is the router-path tracer-overhead measurement
+(PERF.md "Tracer overhead").
 
     python tools/router_bench.py            # on-chip numbers
     python tools/router_bench.py --smoke    # tiny CPU logic check
+    python tools/router_bench.py --smoke --trace   # tracer-overhead row
 """
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -127,6 +140,10 @@ def _run(cfg, params, prompts, max_new, ref, kill_step=None,
                 recovery_steps = s - kill_step
                 break
 
+    # Close BEFORE summarizing: close() runs the SLO monitor's forced
+    # final sweep (a partial tail window still gets judged) and writes
+    # the merged fleet trace when inference.trace_path is set.
+    router.close()
     outcomes: dict[str, int] = {}
     for rr in reqs:
         outcomes[rr.outcome or "MISSING"] = (
@@ -142,6 +159,7 @@ def _run(cfg, params, prompts, max_new, ref, kill_step=None,
             "metrics": bench_metrics_block(h.engine, timing=t),
         })
     out = {
+        "slo": router._slo.metrics() if router._slo is not None else {},
         "mode": "chaos" if kill_step is not None else "baseline",
         "replicas": cfg.router.replicas,
         "requests": len(reqs),
@@ -165,14 +183,96 @@ def _run(cfg, params, prompts, max_new, ref, kill_step=None,
         "finished": finished,
         "killed_inflight": killed_inflight or [],
     }
-    router.close()
     return out, records
+
+
+def _check_merged_trace(path, replicas, rids, retried_rids):
+    """The ISSUE 14 acceptance pins on the chaos run's merged fleet
+    timeline: it exists and parses; the router plus EVERY replica
+    process contributed >= 1 span (the killed replica ran until the
+    kill, so its final spans are in the merge); every measured request
+    rid has exactly ONE router-process outcome instant; every failover'd
+    rid's lifecycle instants appear on >= 2 replica tracks with the
+    ``retried`` tag on the re-placed attempt, including a submit ->
+    outcome pair on a survivor."""
+    out = {
+        "merged_trace_written": False,
+        "merged_spans_per_replica": False,
+        "merged_one_outcome_per_rid": False,
+        "merged_failover_on_two_tracks": False,
+        "merged_retried_tag_present": False,
+    }
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return out
+    evs = doc.get("traceEvents", [])
+    procs = {
+        e["pid"]: e["args"]["name"] for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    if not procs or len(procs) != replicas + 1:
+        return out
+    out["merged_trace_written"] = True
+    spans_per_pid = {}
+    for e in evs:
+        if e.get("ph") == "X":
+            spans_per_pid[e["pid"]] = spans_per_pid.get(e["pid"], 0) + 1
+    replica_pids = {
+        pid for pid, name in procs.items() if name.startswith("replica")
+    }
+    router_pid = next(
+        pid for pid, name in procs.items() if name == "router"
+    )
+    # The router emits instants (decisions + lifecycle), not spans; the
+    # per-replica span floor is the "all replica compute is in the
+    # merge" pin.
+    out["merged_spans_per_replica"] = all(
+        spans_per_pid.get(pid, 0) >= 1 for pid in replica_pids
+    )
+    outcome_counts = {rid: 0 for rid in rids}
+    tid_replica_tracks: dict = {}
+    retried_tagged = set()
+    survivor_outcome = set()
+    for e in evs:
+        if e.get("ph") != "i":
+            continue
+        a = e.get("args", {})
+        if e["pid"] == router_pid and e.get("name") == "outcome":
+            rid = a.get("rid")
+            if rid in outcome_counts:
+                outcome_counts[rid] += 1
+        if e["pid"] in replica_pids and "tid" in a:
+            tid_replica_tracks.setdefault(a["tid"], set()).add(e["pid"])
+            if a.get("retried"):
+                retried_tagged.add(a["tid"])
+                if e.get("name") == "outcome":
+                    survivor_outcome.add(a["tid"])
+    out["merged_one_outcome_per_rid"] = all(
+        c == 1 for c in outcome_counts.values()
+    )
+    out["merged_failover_on_two_tracks"] = bool(retried_rids) and all(
+        len(tid_replica_tracks.get(rid, ())) >= 2 for rid in retried_rids
+    )
+    out["merged_retried_tag_present"] = bool(retried_rids) and all(
+        rid in retried_tagged and rid in survivor_outcome
+        for rid in retried_rids
+    )
+    return out
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--smoke", action="store_true",
                    help="tiny CPU config; assert the chaos pin")
+    p.add_argument("--trace", action="store_true",
+                   help="span tracer ON for the baseline run too — its "
+                        "wall_s vs a plain run is the router-path "
+                        "tracer-overhead measurement")
+    p.add_argument("--trace-path", default=None,
+                   help="merged fleet trace target for the chaos run "
+                        "(default: <tmpdir>/router_bench_trace.json)")
     p.add_argument("--preset", default="tiny-llama")
     p.add_argument("--replicas", type=int, default=3)
     p.add_argument("--requests", type=int, default=10)
@@ -187,6 +287,8 @@ def main(argv=None) -> int:
 
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
     from orion_tpu.config import get_config
     from orion_tpu.infer import InferenceEngine
     from orion_tpu.models import init_params
@@ -201,6 +303,13 @@ def main(argv=None) -> int:
         "inference.prefix_cache=true",
         f"router.replicas={args.replicas}",
         "router.affinity_min_tokens=16",
+        # SLO objectives (obs/slo.py): generous targets — the pin is the
+        # MECHANICS (windows judged, zero false breaches on a healthy
+        # uncontended fleet), not a latency bar for a CPU smoke whose
+        # first-request TTFT includes jit compiles.
+        "slo.ttft_ms=120000",
+        "slo.itl_ms=60000",
+        "slo.window_s=2.0",
     ]
     cfg = get_config(args.preset, overrides)
     params = init_params(cfg.model, jax.random.key(0))
@@ -213,15 +322,33 @@ def main(argv=None) -> int:
         prompts, args.max_new
     )
 
+    # The chaos run always records + merges the fleet timeline (the
+    # ISSUE 14 acceptance artifact); the baseline records only under
+    # --trace (so a plain --smoke baseline stays the untraced-overhead
+    # reference).
+    trace_path = args.trace_path or os.path.join(
+        tempfile.mkdtemp(prefix="router_bench_"),
+        "router_bench_trace.json",
+    )
+    chaos_cfg = get_config(
+        args.preset, overrides + [f"inference.trace_path={trace_path}"]
+    )
+    base_cfg = (
+        get_config(args.preset, overrides + ["inference.trace=true"])
+        if args.trace else cfg
+    )
+
     prime = [warm + [40], warm + [41]]
-    base, base_rec = _run(cfg, params, prompts, args.max_new,
+    base, base_rec = _run(base_cfg, params, prompts, args.max_new,
                           {"rate": 0.0}, prime=prime)
+    base["trace"] = args.trace
     print(json.dumps(base), flush=True)
     chaos, chaos_rec = _run(
-        cfg, params, prompts, args.max_new,
+        chaos_cfg, params, prompts, args.max_new,
         {"rate": base["tokens_per_step"]}, kill_step=args.kill_step,
         prime=prime,
     )
+    chaos["trace_path"] = trace_path
     print(json.dumps(chaos), flush=True)
 
     def check(run, rec):
@@ -251,6 +378,11 @@ def main(argv=None) -> int:
         chaos["recovery_steps"] is not None
         and chaos["recovery_steps"] <= args.recovery_bound
     )
+    trace_checks = _check_merged_trace(
+        trace_path, args.replicas,
+        [rr.rid for rr in chaos_rec["reqs"]],
+        [rr.rid for rr in chaos_rec["reqs"] if rr.retries > 0],
+    )
     verdict = {
         "verdict": True,
         "baseline_all_typed": b_typed,
@@ -266,6 +398,12 @@ def main(argv=None) -> int:
         "throughput_recovered_to_two_thirds": recovered,
         "recovery_steps": chaos["recovery_steps"],
         "recovery_bound": args.recovery_bound,
+        # Fleet obs pins (ISSUE 14): merged timeline + SLO mechanics.
+        **trace_checks,
+        "slo_windows_judged": base["slo"].get("windows", 0) >= 1,
+        "baseline_slo_zero_breaches": (
+            base["slo"].get("breaches", 0) == 0
+        ),
     }
     verdict["verdict"] = all(
         v for k, v in verdict.items()

@@ -176,7 +176,7 @@ def _serve_outcomes(eng, subs, deadline_s):
     return recs, step_times
 
 
-def _overload_summary(recs, step_times, mode):
+def _overload_summary(recs, step_times, mode, slo_cfg=None):
     """Aggregate one overload run: typed-outcome counts, accepted-request
     TTFT/ITL percentiles, shed priorities and the worst deadline overrun
     measured in steps (expiry reaping at step boundaries bounds it at ~1
@@ -250,7 +250,33 @@ def _overload_summary(recs, step_times, mode):
             }
 
         reg.register(section, provider)
+    # SLO judgment over the same per-class collectors (ISSUE 14: the
+    # PR 8 per-class percentiles finally judged against objectives, not
+    # just reported): replay completed-request TTFT/ITL through an
+    # SLOMonitor built from cfg.slo and force-close one window — burn
+    # rates + breach counts ride the JSON line next to the percentiles.
+    slo_block = None
+    if slo_cfg is not None and slo_cfg.enabled:
+        from orion_tpu.obs import SLOMonitor
+
+        mon = SLOMonitor.from_config(slo_cfg)
+        for r in recs:
+            if r["req"].outcome != "completed":
+                continue
+            if r["first"] is not None:
+                mon.observe(
+                    "ttft", r["priority"], r["first"] - r["submit"], 0.0
+                )
+            for g in r["gaps"]:
+                mon.observe("itl", r["priority"], g, 0.0)
+        mon.sweep(0.0, force=True)
+        slo_block = {
+            "breaches": mon.breaches,
+            **{k: v for k, v in mon.metrics().items()
+               if k.startswith("burn_")},
+        }
     return {
+        "slo": slo_block,
         "per_class": reg.snapshot(),
         "mode": mode,
         "offered": offered,
@@ -289,6 +315,11 @@ def overload_main(smoke: bool) -> int:
             "inference.max_seq_len=1024", "inference.page_size=64",
             "inference.num_pages=48", "inference.max_batch_size=4",
             "inference.prefill_chunk=64", "inference.decode_window=1",
+            # Per-class SLO objective (obs/slo.py): judge the high
+            # class's tail against a generous CPU-smoke bar — the pin is
+            # that the judgment RUNS and a healthy run burns zero budget,
+            # not a latency bar for a smoke with jit compiles in it.
+            "slo.per_class=1:ttft=120000,itl=60000",
         ]
         prompt_len, new_tokens, deadline_s = 8, 24, 60.0
     else:
@@ -297,6 +328,9 @@ def overload_main(smoke: bool) -> int:
             "inference.max_seq_len=2048", "inference.page_size=64",
             "inference.num_pages=1024", "inference.max_batch_size=8",
             "inference.prefill_chunk=256", "inference.decode_window=1",
+            # On-chip bar for the high class (the ROADMAP multi-tenant
+            # SLO: priority 1 = interactive traffic).
+            "slo.per_class=1:ttft=2000,itl=100",
         ]
         prompt_len, new_tokens, deadline_s = 32, 128, 120.0
 
@@ -332,7 +366,7 @@ def overload_main(smoke: bool) -> int:
         _serve_outcomes(eng, [(mk(), 1, 4)], deadline_s)
         recs, step_times = _serve_outcomes(eng, subs, deadline_s)
         eng.assert_page_accounting()
-        r = _overload_summary(recs, step_times, mode)
+        r = _overload_summary(recs, step_times, mode, slo_cfg=c.slo)
         t = eng.reset_timing()
         r["engine_shed"] = t["shed_requests"]
         r["engine_expired"] = t["expired_requests"]
@@ -366,6 +400,11 @@ def overload_main(smoke: bool) -> int:
         "itl_p99_ratio": round(
             ov["itl_p99_ms"] / un["itl_p99_ms"], 4
         ) if un["itl_p99_ms"] else None,
+        # SLO burn (obs/slo.py): the high class's judged breach count per
+        # mode — shedding the LOW class is exactly how the hi-class
+        # objective survives 2x offered load.
+        "slo_breaches_uncontended": (un.get("slo") or {}).get("breaches"),
+        "slo_breaches_overload": (ov.get("slo") or {}).get("breaches"),
     }
     print(json.dumps(verdict))
     return 0
