@@ -1,0 +1,21 @@
+"""Static analysis for orion-tpu (ISSUE 15).
+
+Two layers, both *static* — nothing here executes a compiled program:
+
+- :mod:`orion_tpu.analysis.contracts` — declarative contracts over the
+  compiled artifacts (jaxpr / StableHLO / optimized HLO / memory analysis)
+  of the programs the stack actually dispatches: the train step at a given
+  parallel layout and the serving engine's prefill/decode/verify/mixed
+  programs. ``tools/contract_check.py`` sweeps a layout grid.
+- :mod:`orion_tpu.analysis.lint` — an AST pass with repo-specific rules
+  (host syncs in dispatch hot paths, wall clocks in obs, unregistered
+  Stats classes, validation-free Config dataclasses, overbroad excepts in
+  fault envelopes). ``tools/lint.py`` is the CLI.
+
+SANITIZERS.md ("Static contracts & lint") maps each contract and rule to
+the failure class it guards.
+"""
+
+from orion_tpu.analysis import contracts, lint  # noqa: F401
+
+__all__ = ["contracts", "lint"]

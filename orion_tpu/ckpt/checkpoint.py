@@ -385,6 +385,7 @@ class CheckpointManager:
                     return
                 self._commit(*job)
                 log.info("checkpoint committed at step %d (async)", job[0])
+            # orion: allow[fault-except] async writer thread: EVERY failure (incl. KeyboardInterrupt) must park in save_error for wait() to re-raise
             except BaseException as e:  # noqa: BLE001 — surfaced via wait()
                 self.save_error = e
                 log.exception("async checkpoint save failed")

@@ -321,6 +321,55 @@ class OptimizerConfig:
     # Dtype of Adam moments; bf16 halves optimizer HBM at slight quality cost.
     moment_dtype: str = "float32"
 
+    def __post_init__(self):
+        if self.name not in ("adamw", "sgd"):
+            raise ValueError(f"optimizer.name={self.name!r}; adamw|sgd")
+        if self.schedule not in ("cosine", "linear", "constant"):
+            raise ValueError(
+                f"optimizer.schedule={self.schedule!r}; "
+                f"cosine|linear|constant"
+            )
+        if self.learning_rate <= 0:
+            raise ValueError(
+                f"optimizer.learning_rate={self.learning_rate} must be > 0"
+            )
+        if not 0.0 <= self.min_lr_ratio <= 1.0:
+            raise ValueError(
+                f"optimizer.min_lr_ratio={self.min_lr_ratio} not in [0, 1]"
+            )
+        if self.warmup_steps < 0:
+            raise ValueError(
+                f"optimizer.warmup_steps={self.warmup_steps} must be >= 0"
+            )
+        if self.decay_steps is not None and self.decay_steps < 1:
+            raise ValueError(
+                f"optimizer.decay_steps={self.decay_steps} must be >= 1"
+            )
+        for knob in ("b1", "b2"):
+            v = getattr(self, knob)
+            if not 0.0 <= v < 1.0:
+                raise ValueError(f"optimizer.{knob}={v} not in [0, 1)")
+        if self.eps <= 0:
+            raise ValueError(f"optimizer.eps={self.eps} must be > 0")
+        if self.weight_decay < 0:
+            raise ValueError(
+                f"optimizer.weight_decay={self.weight_decay} must be >= 0"
+            )
+        if self.grad_clip_norm < 0:
+            raise ValueError(
+                f"optimizer.grad_clip_norm={self.grad_clip_norm} "
+                f"must be >= 0 (0 disables clipping)"
+            )
+        import numpy as _np
+
+        try:
+            _np.dtype(self.moment_dtype)
+        except TypeError as e:
+            raise ValueError(
+                f"optimizer.moment_dtype={self.moment_dtype!r} is not a "
+                f"dtype name"
+            ) from e
+
 
 @dataclass(frozen=True)
 class ParallelConfig:
@@ -419,6 +468,29 @@ class DataConfig:
     # different shuffle seed (disjoint windows with high probability).
     eval_path: Optional[str] = None
     eval_seed: int = 1_000_003
+
+    def __post_init__(self):
+        if self.source not in ("synthetic", "memmap"):
+            raise ValueError(
+                f"data.source={self.source!r}; synthetic|memmap"
+            )
+        if self.source == "memmap" and not self.path:
+            raise ValueError("data.source=memmap requires data.path")
+        if self.batch_size < 1:
+            raise ValueError(
+                f"data.batch_size={self.batch_size} must be >= 1"
+            )
+        if self.seq_len < 1:
+            raise ValueError(f"data.seq_len={self.seq_len} must be >= 1")
+        if self.eos_token_id < 0:
+            raise ValueError(
+                f"data.eos_token_id={self.eos_token_id} must be >= 0"
+            )
+        if self.pack_carry_group < 1:
+            raise ValueError(
+                f"data.pack_carry_group={self.pack_carry_group} "
+                f"must be >= 1"
+            )
 
 
 @dataclass(frozen=True)
@@ -1085,8 +1157,34 @@ class RuntimeConfig:
     # (adds a per-step error fetch); see SANITIZERS.md.
     checkify: bool = False
 
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(
+                f"runtime.num_processes={self.num_processes} must be >= 1"
+            )
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"runtime.process_id={self.process_id} not in "
+                f"[0, {self.num_processes})"
+            )
+        if self.num_processes > 1 and not self.coordinator_address:
+            raise ValueError(
+                "runtime.num_processes > 1 requires "
+                "runtime.coordinator_address"
+            )
+        if self.platform is not None and self.platform not in (
+            "cpu", "tpu", "gpu"
+        ):
+            raise ValueError(
+                f"runtime.platform={self.platform!r}; cpu|tpu|gpu|None"
+            )
 
+
+# Pure composite: every leaf validates itself in its own __post_init__ and
+# the cross-SECTION checks need runtime context (mesh shapes, kernel
+# availability), so they live in Trainer.__init__ / InferenceEngine.__init__.
 @dataclass(frozen=True)
+# orion: allow[config-validation] composite node; leaves self-validate, cross-field checks live in the consumers
 class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
@@ -1152,31 +1250,48 @@ def _auto(raw: str) -> Any:
 
 
 def apply_overrides(cfg: Config, overrides: Sequence[str]) -> Config:
-    """Apply ``section.key=value`` overrides to a Config, returning a new one."""
+    """Apply ``section.key=value`` overrides to a Config, returning a new one.
+
+    Same-section overrides are batched into ONE ``replace`` so a leaf
+    dataclass's ``__post_init__`` cross-field checks see the whole
+    override set at once — ``data.source=memmap data.path=...`` must
+    validate identically in either flag order (ISSUE 15: the leaf configs
+    now all validate at construction). Duplicate keys keep last-wins."""
+    groups: dict[tuple, dict] = {}
     for item in overrides:
         if "=" not in item:
             raise ValueError(f"override must be key=value, got {item!r}")
         key, raw = item.split("=", 1)
-        parts = key.split(".")
-        cfg = _apply_one(cfg, parts, raw)
+        parts = tuple(key.split("."))
+        groups.setdefault(parts[:-1], {})[parts[-1]] = raw
+    for parent, kv in groups.items():
+        cfg = _apply_group(cfg, parent, kv)
     return cfg
 
 
-def _apply_one(node: Any, parts: Sequence[str], raw: str) -> Any:
-    name = parts[0]
-    if name not in {f.name for f in fields(node)}:
-        valid = ", ".join(f.name for f in fields(node))
-        raise ValueError(f"unknown config key {name!r}; valid: {valid}")
-    if len(parts) == 1:
-        # `from __future__ import annotations` stringifies f.type; resolve the
-        # real type object so Optional[int] etc. parse correctly.
-        hints = typing.get_type_hints(type(node))
+def _apply_group(node: Any, parent: Sequence[str], kv: Mapping[str, str]):
+    names = {f.name for f in fields(node)}
+    if parent:
+        name = parent[0]
+        if name not in names:
+            valid = ", ".join(f.name for f in fields(node))
+            raise ValueError(f"unknown config key {name!r}; valid: {valid}")
+        return replace(
+            node, **{name: _apply_group(getattr(node, name), parent[1:], kv)}
+        )
+    # `from __future__ import annotations` stringifies f.type; resolve the
+    # real type objects so Optional[int] etc. parse correctly.
+    hints = typing.get_type_hints(type(node))
+    updates = {}
+    for name, raw in kv.items():
+        if name not in names:
+            valid = ", ".join(f.name for f in fields(node))
+            raise ValueError(f"unknown config key {name!r}; valid: {valid}")
         try:
-            value = _parse_value(raw, hints[name])
+            updates[name] = _parse_value(raw, hints[name])
         except ValueError as e:
             raise ValueError(f"bad value for config key {name!r}: {e}") from e
-        return replace(node, **{name: value})
-    return replace(node, **{name: _apply_one(getattr(node, name), parts[1:], raw)})
+    return replace(node, **updates)
 
 
 # ---------------------------------------------------------------------------

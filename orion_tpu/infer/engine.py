@@ -2078,10 +2078,10 @@ class InferenceEngine:
                 )
             if self._guard:
                 acc, alt, ok, self.cache = out
-                acc, alt, okh = jax.device_get((acc, alt, ok))  # ONE fetch
+                acc, alt, okh = jax.device_get((acc, alt, ok))  # orion: allow[host-sync] the verify step's ONE documented fetch
             else:
                 acc, alt, self.cache = out
-                acc, alt = jax.device_get((acc, alt))   # ONE fetch
+                acc, alt = jax.device_get((acc, alt))   # orion: allow[host-sync] the verify step's ONE documented fetch
                 okh = None
         self.timing["slot_steps"] += len(active)
         if okh is not None:
@@ -2241,7 +2241,9 @@ class InferenceEngine:
                         jnp.asarray(self.seq_lens),
                         jnp.asarray(src),
                     )
+                    # orion: allow[host-sync] compaction must surface device errors BEFORE any token is emitted
                     jax.block_until_ready(self.cache)
+            # orion: allow[fault-except] dispatch envelope: ANY compaction failure becomes a failed step, never an emission
             except Exception as e:
                 self.robust.dispatch_faults += 1
                 self._flight_note(
@@ -2337,11 +2339,11 @@ class InferenceEngine:
                 )
             if self._guard:
                 toks, ok, self.cache = out
-                tokens, okh = jax.device_get((toks, ok))   # ONE fetch
+                tokens, okh = jax.device_get((toks, ok))   # orion: allow[host-sync] the decode window's ONE documented fetch
                 tokens = np.asarray(tokens)
             else:
                 toks, self.cache = out
-                tokens = np.asarray(jax.device_get(toks))  # [W, B], ONE fetch
+                tokens = np.asarray(jax.device_get(toks))  # orion: allow[host-sync] [W, B] — the decode window's ONE documented fetch
                 okh = None
         self.timing["slot_steps"] += W * len(active)
         if okh is not None:
@@ -2534,10 +2536,10 @@ class InferenceEngine:
                     )
                 if self._guard:
                     acc, alt, ok, p_logits, self.cache = out
-                    acc, alt, okh = jax.device_get((acc, alt, ok))  # 1 fetch
+                    acc, alt, okh = jax.device_get((acc, alt, ok))  # orion: allow[host-sync] the mixed-verify step's ONE documented fetch
                 else:
                     acc, alt, p_logits, self.cache = out
-                    acc, alt = jax.device_get((acc, alt))   # ONE fetch
+                    acc, alt = jax.device_get((acc, alt))   # orion: allow[host-sync] the verify step's ONE documented fetch
                     okh = None
         else:
             common = (
@@ -2560,11 +2562,11 @@ class InferenceEngine:
                     )
                 if self._guard:
                     d_toks, ok, p_logits, self.cache = out
-                    d_out, okh = jax.device_get((d_toks, ok))   # ONE fetch
+                    d_out, okh = jax.device_get((d_toks, ok))   # orion: allow[host-sync] the mixed step's ONE documented fetch
                     d_out = np.asarray(d_out)
                 else:
                     d_toks, p_logits, self.cache = out
-                    d_out = np.asarray(jax.device_get(d_toks))  # [B], 1 fetch
+                    d_out = np.asarray(jax.device_get(d_toks))  # orion: allow[host-sync] [B] — the mixed step's ONE documented fetch
                     okh = None
         real = sum(k for _, k in chunks)
         self.timing["mixed_steps"] += 1
@@ -2586,6 +2588,7 @@ class InferenceEngine:
         if finishing:
             rows = jnp.asarray([i for i, _ in finishing])
             firsts = self._sample(p_logits[rows], [r for _, r in finishing])
+            # orion: allow[host-sync] finishing prompts need their sampled first token on the host this step
             for (_, r), first in zip(finishing, np.asarray(firsts)):
                 r.prefill_pending = False
                 if r.max_new_tokens <= 0:

@@ -158,8 +158,10 @@ class DispatchExecutor:
             # device profile so xprof rows align with the Chrome export.
             with eng._tracer.annotation("orion/" + path):
                 out = getattr(eng, "_" + name)(*args, **kwargs)
+                # orion: allow[host-sync] THE envelope sync point: execute-time faults must surface here, not at the caller's fetch
                 jax.block_until_ready(out)
             return out
+        # orion: allow[fault-except] the fault envelope exists to contain ANY dispatch failure (DispatchFault re-raise below)
         except Exception as e:
             eng.robust.dispatch_faults += 1
             eng._flight_note(
@@ -193,7 +195,9 @@ class DispatchExecutor:
                         "orion/" + path + "/fallback"
                     ):
                         out = fb(*args, **kwargs)
+                        # orion: allow[host-sync] fallback attempts must surface their own execute-time faults inside the retry loop
                         jax.block_until_ready(out)
+                # orion: allow[fault-except] retry-ladder rung: a failed fallback attempt feeds the next retry, then DispatchFault
                 except Exception as e2:
                     eng.robust.dispatch_faults += 1
                     last = e2

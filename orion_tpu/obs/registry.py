@@ -39,6 +39,19 @@ Provider = Callable[[], Mapping[str, Any]]
 _NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 _PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
 
+# JSONL time-series stamps must be wall-clock (cross-host comparable) but
+# may never step backwards within a process — an NTP slew mid-run would
+# reorder the series a dashboard diffs. Anchor the wall clock once and
+# advance it on the monotonic clock (obs clock discipline, tools/lint.py
+# `clock` rule).
+_T0_WALL = time.time()  # orion: allow[clock] one-off wall anchor; stamps advance monotonically from it
+_T0_MONO = time.monotonic()
+
+
+def _wall_now() -> float:
+    """Monotonic-within-process wall-clock seconds."""
+    return _T0_WALL + (time.monotonic() - _T0_MONO)
+
 
 def live_hbm_metrics(device: Optional[jax.Device] = None) -> dict[str, int]:
     """Live device-memory gauges from the backend allocator, or {} when
@@ -140,8 +153,10 @@ class MetricsRegistry:
         """Append one time-series row ({"ts": unix_seconds, **snapshot})
         to a JSONL file; returns the row. The serving engine calls this
         from ``reset_timing`` when ``inference.metrics_jsonl`` is set, so
-        every drain window becomes one comparable row."""
-        row = {"ts": time.time()}
+        every drain window becomes one comparable row. The stamp is the
+        monotonic-anchored wall clock (``_wall_now``): comparable across
+        hosts, never backwards within the process."""
+        row = {"ts": _wall_now()}
         row.update(self.snapshot() if snapshot is None else snapshot)
         with open(path, "a") as f:
             f.write(json.dumps(row, default=str) + "\n")

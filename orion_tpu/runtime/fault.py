@@ -256,6 +256,7 @@ class Watchdog:
                 self._fired = True
                 try:
                     self.on_stall(elapsed)
+                # orion: allow[fault-except] a broken stall observer must not kill the watchdog thread it reports through
                 except Exception:
                     log.exception("watchdog on_stall callback failed")
 
@@ -406,6 +407,7 @@ class FaultInjector:
                 if self.on_fire is not None:
                     try:
                         self.on_fire(kind, step, path)
+                    # orion: allow[fault-except] a broken flight-recorder observer must not change WHICH faults fire
                     except Exception:
                         log.exception("FaultInjector on_fire observer failed")
                 return s

@@ -106,3 +106,41 @@ def test_tuple_override_forms():
         ("parallel.dcn_axes=dp,fsdp", ("dp", "fsdp")),
     ]:
         assert get_config("tiny", [ov]).parallel.dcn_axes == want, ov
+
+
+def test_leaf_configs_validate_and_overrides_batch_per_section():
+    """ISSUE 15: every leaf *Config validates in __post_init__, and
+    same-section overrides apply as ONE replace — cross-field checks
+    (memmap-requires-path) hold in either flag order."""
+    import pytest
+
+    from orion_tpu.config import (
+        DataConfig, OptimizerConfig, RuntimeConfig, get_config,
+    )
+
+    # Cross-field check is order-independent under the override parser.
+    for order in (
+        ["data.source=memmap", "data.path=/tmp/x.bin"],
+        ["data.path=/tmp/x.bin", "data.source=memmap"],
+    ):
+        assert get_config("tiny", order).data.source == "memmap"
+    with pytest.raises(ValueError, match="requires data.path"):
+        get_config("tiny", ["data.source=memmap"])
+
+    with pytest.raises(ValueError, match="learning_rate"):
+        OptimizerConfig(learning_rate=0.0)
+    with pytest.raises(ValueError, match="schedule"):
+        OptimizerConfig(schedule="sawtooth")
+    with pytest.raises(ValueError, match="b2"):
+        OptimizerConfig(b2=1.0)
+    with pytest.raises(ValueError, match="batch_size"):
+        DataConfig(batch_size=0)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        RuntimeConfig(num_processes=2)
+    with pytest.raises(ValueError, match="process_id"):
+        RuntimeConfig(num_processes=2, process_id=5,
+                      coordinator_address="h:1234")
+    with pytest.raises(ValueError, match="platform"):
+        RuntimeConfig(platform="abacus")
+    with pytest.raises(ValueError, match="moment_dtype"):
+        OptimizerConfig(moment_dtype="flaot32")

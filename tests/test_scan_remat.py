@@ -21,7 +21,6 @@ Heavy shapes / end-to-end trainer compositions are `slow` per the tier-1
 budget convention (ROADMAP.md).
 """
 
-import re
 
 import jax
 import jax.numpy as jnp
@@ -123,29 +122,10 @@ def test_scan_group_with_full_and_none_remat_close():
 
 
 # -- HLO structure: the stash-write reduction is textually provable -------
-
-_DUS_RE = re.compile(
-    r"stablehlo\.dynamic_update_slice[^\n]*:\s*"
-    r"\(tensor<(\d+)x[^>]*>,\s*tensor<(\d+)x"
-)
-
-
-def executed_stacked_dus(lowered_text: str) -> int:
-    """Executed stacked-buffer DUS writes in a lowered train-step module.
-
-    A scan writing per-iteration slices lowers to a while whose body does
-    one dynamic_update_slice of a [1, ...]-leading update into a
-    [trip_count, ...]-leading buffer — so each such op EXECUTES
-    trip_count slice writes. Summing target leading dims over ops with a
-    unit-leading update counts exactly the fwd stash + bwd stacked-grad
-    traffic the grouped scan is built to shrink.
-    """
-    total = 0
-    for m in _DUS_RE.finditer(lowered_text):
-        target_lead, update_lead = int(m.group(1)), int(m.group(2))
-        if update_lead == 1 and target_lead > 1:
-            total += target_lead
-    return total
+# The DUS counter moved to the shared contract engine (ISSUE 15):
+# orion_tpu.analysis.contracts.executed_stacked_dus is the single
+# definition both this pin and tools/contract_check.py matchers use.
+from orion_tpu.analysis.contracts import executed_stacked_dus  # noqa: E402
 
 
 def _lowered_grad_text(overrides):

@@ -241,20 +241,33 @@ def test_guard_on_clean_step_matches_guard_off_bitwise():
 
 def test_guard_off_trace_has_no_finiteness_ops():
     """The guard-off compiled train step is the pre-guard program: no
-    is_finite / anomaly plumbing is ever staged unless the knob is on."""
+    is_finite / anomaly plumbing is ever staged unless the knob is on.
+    MIGRATED onto the shared contract engine (ISSUE 15): the pin now
+    runs through the same no_finiteness_ops / finiteness_staged
+    predicates tools/contract_check.py sweeps across layouts — but on
+    THIS test file's own small trainer shapes, so the pin and the sweep
+    can never drift apart."""
+    from orion_tpu.analysis import contracts as C
+
     t = Trainer(_cfg())
     state = t.abstract_state()
     batch = jax.tree.map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
         t.global_batch(0),
     )
-    txt_off = t._jit_step.lower(state, batch).as_text()
-    assert "is_finite" not in txt_off and "is-finite" not in txt_off
+    art_off = C.ProgramArtifact(
+        "guard_off", lowered=t._jit_step.lower(state, batch),
+        traced=C._try_trace(t._jit_step, (state, batch)),
+    )
+    assert C.check_artifact(art_off, (C.no_finiteness_ops,), "off") == []
 
     t_on = Trainer(_cfg(extra=("train.anomaly_guard=true",)))
     limit = jax.ShapeDtypeStruct((), np.float32)
-    txt_on = t_on._jit_step.lower(state, batch, limit).as_text()
-    assert "is_finite" in txt_on or "is-finite" in txt_on
+    art_on = C.ProgramArtifact(
+        "guard_on", lowered=t_on._jit_step.lower(state, batch, limit),
+        traced=C._try_trace(t_on._jit_step, (state, batch, limit)),
+    )
+    assert C.check_artifact(art_on, (C.finiteness_staged,), "on") == []
 
 
 def test_guard_keeps_donation_aliasing():

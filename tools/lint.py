@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Repo-native lint CLI (orion_tpu.analysis.lint; ISSUE 15 layer 2).
+
+Rules (each suppressible per-site via ``# orion: allow[<rule>] <reason>``):
+host syncs in engine/runner/executor dispatch bodies, wall clocks inside
+orion_tpu, *Stats dataclasses off the reset_timing protocol, *Config
+dataclasses without __post_init__ validation, bare/overbroad excepts in
+fault envelopes — plus ``bad-allow`` (suppression without a reason) and
+``unused-allow`` (stale suppression). SANITIZERS.md maps each rule to its
+failure class.
+
+    python tools/lint.py              # full sweep: orion_tpu/, tools/, entry scripts
+    python tools/lint.py --diff      # only files changed vs HEAD
+    python tools/lint.py --diff main # only files changed vs main
+    python tools/lint.py -v          # show suppressed findings too
+
+Exit: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+"""
+from __future__ import annotations
+
+import sys as _sys, pathlib as _pathlib
+_ROOT = _pathlib.Path(__file__).resolve().parent.parent
+_sys.path.insert(0, str(_ROOT))
+
+import argparse
+import subprocess
+import sys
+
+from orion_tpu.analysis import lint
+
+
+def _diff_files(ref: str) -> list:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    if out.returncode != 0:
+        raise SystemExit(f"git diff {ref} failed: {out.stderr.strip()}")
+    tracked = [l.strip() for l in out.stdout.splitlines() if l.strip()]
+    # Untracked files are new code — lint them too.
+    out = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    tracked += [l.strip() for l in out.stdout.splitlines() if l.strip()]
+    targets = set()
+    for rel in tracked:
+        if not rel.endswith(".py"):
+            continue
+        if any(
+            rel == t or rel.startswith(t + "/")
+            for t in lint.DEFAULT_TARGETS
+        ):
+            targets.add(_ROOT / rel)
+    return sorted(targets)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--diff", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="lint only files changed vs REF (default HEAD) + untracked",
+    )
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print suppressed findings with their reasons")
+    p.add_argument("--rules", action="store_true",
+                   help="list the rules and exit")
+    args = p.parse_args(argv)
+
+    if args.rules:
+        for r in lint.RULES:
+            print(f"{r.name}: {r.doc}")
+        print("bad-allow: allow comment without a reason / unknown rule")
+        print("unused-allow: allow comment that suppresses nothing")
+        print("parse-error: file failed to parse (syntax error)")
+        return 0
+
+    paths = _diff_files(args.diff) if args.diff else None
+    findings = lint.lint_paths(_ROOT, paths)
+    unsuppressed = [f for f in findings if not f.suppressed]
+    shown = findings if args.verbose else unsuppressed
+    for f in shown:
+        print(f)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    print(
+        f"lint: {len(unsuppressed)} finding(s), {n_sup} suppressed"
+        + (f" (scope: {len(paths)} changed file(s))" if paths is not None
+           else "")
+    )
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

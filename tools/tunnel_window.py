@@ -75,6 +75,14 @@ QUEUE = [
     # it reproduces the fake-mesh table (the --smoke twin rides tier-1).
     ("pp_1f1b",
      [sys.executable, str(ROOT / "tools/pp_bubble_bench.py")], 2700),
+    # Full static-contract layout grid (ISSUE 15): the --smoke twin rides
+    # tier-1 on the fake CPU mesh; this entry re-sweeps every contract x
+    # layout variant against the REAL backend's compiled artifacts — the
+    # on-chip XLA pipeline runs different passes (collective combiners,
+    # async collectives, Mosaic kernels), and the collective-inventory /
+    # donation bands must hold there too.
+    ("contract_grid",
+     [sys.executable, str(ROOT / "tools/contract_check.py")], 1800),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
