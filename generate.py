@@ -55,6 +55,20 @@ def main(argv: list[str] | None = None) -> int:
                              "distribution; sugar for "
                              "inference.speculative=true + "
                              "inference.speculate_tokens=N")
+    parser.add_argument("--regex", default=None, metavar="PATTERN",
+                        help="grammar-constrained decoding: every request "
+                             "emits only tokens the regex's FSM admits "
+                             "(byte-level patterns over the byte "
+                             "tokenizer); forced single-choice runs ride "
+                             "the verify path as free drafts; sugar for "
+                             "inference.constrained=true + a per-request "
+                             "ConstraintSpec (mutually exclusive with "
+                             "--json-schema)")
+    parser.add_argument("--json-schema", default=None, metavar="FILE",
+                        help="grammar-constrained decoding from a JSON "
+                             "Schema file: the schema compiles to a "
+                             "regex, then to the same token-level FSM "
+                             "(mutually exclusive with --regex)")
     parser.add_argument("--spec-tree", type=int, default=None, metavar="W",
                         help="token-TREE speculation: draft up to W "
                              "distinct n-gram continuations per step and "
@@ -134,6 +148,36 @@ def main(argv: list[str] | None = None) -> int:
         if args.replicas < 1:
             raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
         overrides.append(f"router.replicas={args.replicas}")
+    constraint = None
+    if args.regex is not None and args.json_schema is not None:
+        raise SystemExit(
+            "--regex and --json-schema are mutually exclusive (one "
+            "grammar per request)"
+        )
+    if args.regex is not None or args.json_schema is not None:
+        from orion_tpu.constrain import ConstraintError, ConstraintSpec, \
+            compile_regex
+
+        try:
+            if args.regex is not None:
+                constraint = ConstraintSpec(regex=args.regex)
+            else:
+                try:
+                    with open(args.json_schema, encoding="utf-8") as f:
+                        schema_text = f.read()
+                except OSError as e:
+                    raise SystemExit(
+                        f"--json-schema {args.json_schema}: {e}"
+                    )
+                constraint = ConstraintSpec(json_schema=schema_text)
+            # Surface malformed patterns/schemas as CLI errors, before
+            # the engine builds (the engine would raise the same
+            # ConstraintError at submit). pattern() parses the schema
+            # frontend; compile_regex parses the regex itself.
+            compile_regex(constraint.pattern())
+        except ConstraintError as e:
+            raise SystemExit(f"invalid constraint: {e}")
+        overrides.append("inference.constrained=true")
     cfg = get_config(args.preset, overrides)
     initialize(cfg.runtime)
 
@@ -189,7 +233,12 @@ def main(argv: list[str] | None = None) -> int:
     # their pages to the prefix cache exactly as normal completion does —
     # and this process exits 0 instead of dying mid-dispatch.
     with PreemptionHandler() as handler:
-        reqs = [engine.submit_request(p, args.max_new_tokens) for p in prompts]
+        reqs = [
+            engine.submit_request(
+                p, args.max_new_tokens, constraint=constraint
+            )
+            for p in prompts
+        ]
         emitted = [0] * len(reqs)
         while engine.has_work():
             if handler.preempted:

@@ -528,6 +528,11 @@ ENGINE_BASE = (
 ENGINE_PROGRAMS = (
     "prefill", "decode", "decode_defaults", "mixed", "mixed_defaults",
     "verify", "verify_defaults", "mixed_verify", "mixed_verify_defaults",
+    # The grammar-masked verify specialization (inference.constrained):
+    # the same _verify_defaults program called with a legal_mask —
+    # switching None -> array is a distinct jit specialization, so the
+    # masked trace gets its own contract row.
+    "verify_masked",
 )
 
 
@@ -661,11 +666,11 @@ def _engine_call(eng, program: str):
         )
         return eng._prefill, args, {}
 
-    if program in ("verify", "verify_defaults"):
+    if program in ("verify", "verify_defaults", "verify_masked"):
         if getattr(eng, "_verify", None) is None:
             raise ContractError(
-                "verify programs need inference.speculative=true in the "
-                "contract overrides"
+                "verify programs need inference.speculative=true or "
+                "inference.constrained=true in the contract overrides"
             )
         W2 = eng.icfg.speculate_tokens + 1
         common = (
@@ -673,6 +678,15 @@ def _engine_call(eng, program: str):
             np.ones(B, i32), pt, mask, key,
         )
         extra = sampling if program == "verify" else ()
+        if program == "verify_masked":
+            # The masked specialization: all-True rows shape the trace
+            # exactly as the engine's host-built FSM masks do.
+            kwargs = {
+                "legal_mask": np.ones(
+                    (B, W2, eng.mcfg.vocab_size), bool
+                ),
+            }
+            return eng._verify_defaults, common, kwargs
         return getattr(eng, "_" + program), common + extra, {}
 
     # mixed / mixed_verify: one-page chunk rows (the chunk width is a
@@ -695,7 +709,8 @@ def _engine_call(eng, program: str):
 
     if getattr(eng, "_mixed_verify", None) is None:
         raise ContractError(
-            "mixed_verify programs need inference.speculative=true AND "
+            "mixed_verify programs need inference.speculative=true (or "
+            "inference.constrained=true) AND "
             "inference.chunked_prefill=true in the contract overrides"
         )
     W2 = eng.icfg.speculate_tokens + 1
@@ -915,6 +930,24 @@ def _registry() -> dict[str, Contract]:
         overrides=("inference.speculative=true",),
         predicates=eng_hygiene,
         doc="speculative verify dispatch: hygiene + cache donation",
+    )
+    add(
+        "constrained_verify_hygiene", "verify_masked",
+        overrides=("inference.constrained=true",),
+        predicates=eng_hygiene + (
+            # Constrained programs may not grow a wire bill: a single-
+            # replica masked verify schedules ZERO collectives, exactly
+            # like its unmasked twin — the FSM mask is a pure elementwise
+            # where() on the logits.
+            collective_inventory(
+                all_gather=0, reduce_scatter=0, all_reduce=0,
+                collective_permute=0, all_to_all=0,
+            ),
+        ),
+        doc="grammar-masked verify specialization "
+            "(inference.constrained): the FSM legal_mask composes into "
+            "the verify program with no host callbacks, no f64, no "
+            "finiteness ops, zero collectives, cache still donated",
     )
     add(
         "mixed_hygiene", "mixed_defaults",

@@ -887,6 +887,28 @@ class InferenceConfig:
     # Prometheus textfile from the drained window + pool/HBM gauges.
     metrics_jsonl: Optional[str] = None
     metrics_prom: Optional[str] = None
+    # --- Grammar-constrained decoding (orion_tpu/constrain; ISSUE 16) --
+    # Accept per-request regex / JSON-schema constraints: submit(...,
+    # constraint=ConstraintSpec(...)) compiles the constraint to a
+    # token-level DFA (memoized across requests by constraint hash) and
+    # every emitted token is filtered through the request's legal-token
+    # mask — composed into sampling.filter_logits, the SAME filtered
+    # target greedy, sampled and speculative verification already share.
+    # Enabling the flag also builds the verify dispatch programs
+    # (constrained slots decode through the verify path: FSM forced runs
+    # are free drafts and the per-position masks are host-precomputable
+    # there, unlike the fused multi-token decode window whose next mask
+    # would depend on a device-side sample). Off by default: an engine
+    # without the flag compiles and serves byte-identically to today.
+    constrained: bool = False
+    # DFA size cap per compiled constraint: subset construction aborts
+    # with a typed ConstraintError past this many states (a hostile or
+    # pathological pattern fails at submit, not by OOM).
+    constraint_max_states: int = 4096
+    # Compiled-artifact LRU: how many distinct (pattern, vocab) DFAs the
+    # process-wide memo keeps. Repeated schemas across requests hit the
+    # cache and pay zero compile.
+    constraint_cache: int = 32
 
     def __post_init__(self):
         # Domain checks only (each field alone), matching ModelConfig's
@@ -938,6 +960,18 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.dispatch_retry_backoff_s="
                 f"{self.dispatch_retry_backoff_s} must be >= 0"
+            )
+        if self.constraint_max_states is None \
+                or self.constraint_max_states < 2:
+            raise ValueError(
+                f"inference.constraint_max_states="
+                f"{self.constraint_max_states} must be >= 2 (a DFA needs "
+                f"at least a start and an accept state)"
+            )
+        if self.constraint_cache is None or self.constraint_cache < 1:
+            raise ValueError(
+                f"inference.constraint_cache={self.constraint_cache} "
+                f"must be >= 1"
             )
 
 

@@ -44,6 +44,7 @@ from orion_tpu.infer.kv_cache import (
 from orion_tpu.infer.scheduler import AdmissionQueue, Request, in_flight
 from orion_tpu.infer.sampling import sample
 from orion_tpu.metrics import (
+    ConstraintStats,
     PrefixCacheStats,
     RobustnessStats,
     SpecDecodeStats,
@@ -330,6 +331,45 @@ class InferenceEngine:
         self.spec_stats = SpecDecodeStats()
         self._spec_step = False     # this step ran verify, not decode
         self._autotune_skip = False  # first step after a window resize
+        # Grammar-constrained decoding (inference.constrained; ISSUE 16):
+        # constrained slots decode through the VERIFY path — FSM forced
+        # runs are free drafts and per-position legal masks are
+        # host-precomputable along a known draft, while the fused
+        # multi-token decode window cannot carry them (the next mask
+        # depends on the device-side sample). So the verify programs are
+        # built for `speculative OR constrained`; the draft budget is
+        # speculate_tokens either way (one static verify width).
+        self.constrained = self.icfg.constrained
+        self.constraint_stats = ConstraintStats()
+        # Forced-run bookkeeping for the CURRENT verify step: slot ->
+        # number of leading draft tokens that were FSM-forced (the
+        # guaranteed-accept prefix); consumed by the acceptance walks.
+        self._constraint_forced: dict[int, int] = {}
+        need_verify = self.icfg.speculative or self.constrained
+        if need_verify and resolve_impl(self.mcfg.kernels)[0]:
+            # Pallas verify path: reject a verify width the ragged
+            # paged-attention kernel cannot hold in VMEM at engine
+            # init — a config error naming the knob, instead of a
+            # Mosaic allocation failure mid-serving.
+            from orion_tpu.ops.pallas.ragged_paged_attention import (
+                check_verify_fit,
+            )
+
+            # Per-SHARD head counts: under tp the kernel runs inside
+            # a head-sharded shard_map with K/tp kv heads per device
+            # (divisibility already validated above), so the fit is
+            # per shard — whole-model counts would reject configs
+            # that actually fit.
+            tp = self.mesh.shape["tp"] if self.mesh is not None else 1
+            check_verify_fit(
+                self.icfg.speculate_tokens + 1,
+                n_heads=self.mcfg.n_heads // tp,
+                n_kv_heads=self.mcfg.n_kv_heads // tp,
+                head_dim=self.mcfg.resolved_head_dim,
+                page_size=self.psz,
+                kv_quant=self.icfg.kv_quant,
+                dtype_itemsize=jnp.dtype(self.mcfg.dtype).itemsize,
+            )
         if self.icfg.speculative:
             from orion_tpu.infer.spec_decode import NgramProposer
 
@@ -337,30 +377,6 @@ class InferenceEngine:
                 raise ValueError(
                     f"inference.spec_min_draft_slots="
                     f"{self.icfg.spec_min_draft_slots} must be >= 1"
-                )
-            if resolve_impl(self.mcfg.kernels)[0]:
-                # Pallas verify path: reject a verify width the ragged
-                # paged-attention kernel cannot hold in VMEM at engine
-                # init — a config error naming the knob, instead of a
-                # Mosaic allocation failure mid-serving.
-                from orion_tpu.ops.pallas.ragged_paged_attention import (
-                    check_verify_fit,
-                )
-
-                # Per-SHARD head counts: under tp the kernel runs inside
-                # a head-sharded shard_map with K/tp kv heads per device
-                # (divisibility already validated above), so the fit is
-                # per shard — whole-model counts would reject configs
-                # that actually fit.
-                tp = self.mesh.shape["tp"] if self.mesh is not None else 1
-                check_verify_fit(
-                    self.icfg.speculate_tokens + 1,
-                    n_heads=self.mcfg.n_heads // tp,
-                    n_kv_heads=self.mcfg.n_kv_heads // tp,
-                    head_dim=self.mcfg.resolved_head_dim,
-                    page_size=self.psz,
-                    kv_quant=self.icfg.kv_quant,
-                    dtype_itemsize=jnp.dtype(self.mcfg.dtype).itemsize,
                 )
             if self.icfg.spec_tree_width > self.icfg.speculate_tokens:
                 raise ValueError(
@@ -403,6 +419,7 @@ class InferenceEngine:
                     ),
                     donate_argnums=(0,),
                 )
+        if need_verify:
             self._verify = self._jit_program("verify", self.mcfg, self.mesh)
             self._verify_defaults = self._jit_program(
                 "verify_defaults", self.mcfg, self.mesh
@@ -439,6 +456,10 @@ class InferenceEngine:
             reg.register("prefix", lambda: self.prefix_stats.as_timing())
         if self.icfg.speculative:
             reg.register("spec", lambda: self.spec_stats.as_timing())
+        if self.icfg.constrained:
+            reg.register(
+                "constrain", lambda: self.constraint_stats.as_timing()
+            )
         reg.register("pool", self._pool_metrics)
         reg.register("hbm", live_hbm_metrics)
 
@@ -677,6 +698,7 @@ class InferenceEngine:
         top_p: Optional[float] = None,
         deadline_s: Optional[float] = None,
         priority: int = 0,
+        constraint: Optional[Any] = None,
     ) -> int:
         """Queue a request; returns its id.
 
@@ -691,6 +713,14 @@ class InferenceEngine:
         queueing unboundedly; the shed request still surfaces from the
         next step().
 
+        ``constraint`` (a ``orion_tpu.constrain.ConstraintSpec``) asks
+        for grammar-constrained output: the emission is guaranteed to
+        match the spec's regex / JSON schema token-for-token. Needs
+        ``inference.constrained=true`` (the flag builds the verify
+        programs constrained slots decode through); the spec compiles at
+        submit (memoized across requests by constraint hash) and a
+        pattern this vocab can never satisfy raises here, typed.
+
         Note: any non-None sampling override switches the WHOLE decode batch
         to the sort-based sampling program (a [B, V] sort per token for every
         co-scheduled slot, plus a one-time second decode compile) until no
@@ -701,6 +731,7 @@ class InferenceEngine:
         return self.submit_request(
             prompt, max_new_tokens, temperature=temperature, top_k=top_k,
             top_p=top_p, deadline_s=deadline_s, priority=priority,
+            constraint=constraint,
         ).rid
 
     def submit_request(
@@ -715,6 +746,7 @@ class InferenceEngine:
         priority: int = 0,
         trace_id: Optional[int] = None,
         attempt: int = 0,
+        constraint: Optional[Any] = None,
     ) -> Request:
         """submit() returning the live Request object instead of its id —
         the CLI/bench/driver surface: callers poll ``.generated`` for
@@ -736,6 +768,41 @@ class InferenceEngine:
             )
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        cstate = None
+        if constraint is not None:
+            # Cross-field check lives here per the config lint rule
+            # (dotted overrides apply one field at a time).
+            if not self.constrained:
+                raise ValueError(
+                    "constraint= needs inference.constrained=true (the "
+                    "flag builds the verify programs constrained slots "
+                    "decode through)"
+                )
+            from orion_tpu.constrain import (
+                ConstraintSpec,
+                ConstraintState,
+                compile_constraint,
+            )
+
+            if not isinstance(constraint, ConstraintSpec):
+                raise ValueError(
+                    f"constraint must be a ConstraintSpec, got "
+                    f"{type(constraint).__name__}"
+                )
+            t0 = time.perf_counter()
+            dfa, hit = compile_constraint(
+                constraint, self.mcfg.vocab_size,
+                max_states=self.icfg.constraint_max_states,
+                cache_size=self.icfg.constraint_cache,
+            )
+            cs = self.constraint_stats
+            cs.requests += 1
+            cs.compiles += 1
+            if hit:
+                cs.compile_hits += 1
+            else:
+                cs.compile_s += time.perf_counter() - t0
+            cstate = ConstraintState(dfa, self.eos_id)
         # Normalize overrides equal to the engine defaults back to None: a
         # request that explicitly passes the default values is sampling-
         # identical to one passing nothing, and must not push the batch onto
@@ -792,6 +859,7 @@ class InferenceEngine:
             ),
             trace_id=trace_id,
             attempt=int(attempt),
+            constraint=cstate,
         )
         if self._tracer.enabled:
             self._tracer.instant(
@@ -1030,7 +1098,9 @@ class InferenceEngine:
         (spec_drafted/accepted/rolled_back/emitted, spec_acceptance_rate,
         verify_steps, verify_slot_steps, spec_tokens_per_verify, and
         spec_gated_steps — steps the draft-density gate sent back to the
-        plain window)."""
+        plain window), and with inference.constrained the grammar
+        counters (constrain_* — compiles/cache hits, masked dispatch
+        volume, forced-run draft/accept tally, completions/dead ends)."""
         out, self.timing = self.timing, self._zero_timing()
         out["decode_window"] = self.decode_window
         if self._pcache is not None:
@@ -1044,6 +1114,12 @@ class InferenceEngine:
                 # counter: the reason survives the drain.
                 disabled_reason=old.disabled_reason,
             )
+        if self.constrained:
+            # Constrained-decoding counters (metrics.ConstraintStats):
+            # compiles/cache hits, masked dispatch volume, and the
+            # forced-run draft/accept tally — drained like spec_stats.
+            out.update(self.constraint_stats.as_timing())
+            self.constraint_stats = ConstraintStats()
         # Robustness counters (metrics.RobustnessStats): typed request
         # outcomes + fault episodes, always present.
         out.update(self.robust.as_timing())
@@ -1778,6 +1854,8 @@ class InferenceEngine:
                 raise
             firsts = self._sample(logits, reqs)  # blocks on the fetch
         for i, req in enumerate(reqs):
+            if req.done:
+                continue   # quarantined during mask build (_sample_masks)
             if req.max_new_tokens <= 0:
                 req.done = True   # prefill-only (scoring) request
                 continue
@@ -1930,6 +2008,7 @@ class InferenceEngine:
         step if the repetition persists."""
         if not cands:
             return None
+        self._constraint_forced = {}   # no forced prefixes on this path
         extra = (
             self._pcache.token_paths() if self._pcache is not None else ()
         )
@@ -1957,6 +2036,150 @@ class InferenceEngine:
             self.spec_stats.gated_steps += 1
             return None
         return drafts
+
+    def _propose_constrained_drafts(
+        self, cands: list[Request]
+    ) -> dict[int, Any]:
+        """Drafting pass for a decode batch that contains constrained
+        slots (these never ride the fused multi-token window: the next
+        mask depends on the device-side sample, but along a KNOWN draft
+        every per-position mask is host-precomputable — the verify
+        layout). Never returns None: zero-draft constrained slots still
+        verify at lens=1 — a masked single-token decode.
+
+        Constrained slots draft their FSM FORCED RUN — single-choice
+        states emit their only legal continuation, whose masked target
+        probability is exactly 1.0, so acceptance is guaranteed under
+        the standard rejection/greedy rule with NO new acceptance math
+        (free tokens). Speculation composes: with inference.speculative
+        the run extends with the n-gram continuation truncated to its
+        FSM-legal prefix; in tree mode an ambiguous state after the run
+        becomes a branch point — up to spec_tree_width legal tokens,
+        each extended by its own forced tail, merged by
+        spec_decode.build_tree. Unconstrained co-tenants draft exactly
+        as _propose_drafts would (or not at all when speculation is
+        off: their lens-1 rows ride the same verify dispatch)."""
+        spec_on = self._spec is not None and not self._spec_disabled
+        extra = (
+            self._pcache.token_paths()
+            if spec_on and self._pcache is not None else ()
+        )
+        tree = self._tree
+        if tree:
+            from orion_tpu.infer.spec_decode import build_tree
+        drafts: dict[int, Any] = {}
+        cs = self.constraint_stats
+        self._constraint_forced = {}
+        for r in cands:
+            pos = int(self.seq_lens[r.slot])
+            limit = min(
+                self.icfg.max_seq_len - 1 - pos,
+                r.max_new_tokens - len(r.generated) - 1,
+                self.icfg.speculate_tokens,
+            )
+            c = r.constraint
+            if c is None:
+                if spec_on and limit > 0:
+                    d = (
+                        self._spec.propose_tree(
+                            r.rid, r.context, limit, extra
+                        ) if tree
+                        else self._spec.propose(
+                            r.rid, r.context, limit, extra
+                        )
+                    )
+                else:
+                    d = None if tree else []
+                drafts[r.slot] = d
+                continue
+            if limit <= 0:
+                drafts[r.slot] = None if tree else []
+                continue
+            forced = c.forced_run(limit)
+            cs.forced_drafted += len(forced)
+            self._constraint_forced[r.slot] = len(forced)
+            end = c.walk(forced)
+            if tree:
+                chains = [forced] if forced else []
+                if (
+                    end >= 0 and len(forced) < limit
+                    and c.mask_choices(end) > 1
+                ):
+                    # FSM branch point: the grammar itself names the
+                    # candidate children — no n-gram statistics needed.
+                    branches = c.branch_tokens(
+                        self.icfg.spec_tree_width, end
+                    )
+                    if len(branches) > 1:
+                        cs.branch_points += 1
+                    bc = []
+                    for b in branches:
+                        nxt = c.peek(int(b), end)
+                        tail = (
+                            c.forced_run(limit - len(forced) - 1, nxt)
+                            if nxt >= 0 else []
+                        )
+                        bc.append(forced + [int(b)] + tail)
+                    chains = bc or chains
+                t = build_tree(chains, limit) if chains else None
+                drafts[r.slot] = t if t is not None and len(t) else None
+            else:
+                d = list(forced)
+                if spec_on and end >= 0 and len(d) < limit:
+                    cont = self._spec.propose(
+                        r.rid, r.context + d, limit - len(d), extra
+                    ) or []
+                    for tok in cont:
+                        nxt = c.peek(int(tok), end)
+                        if nxt < 0:
+                            break   # keep only the FSM-legal prefix
+                        d.append(int(tok))
+                        end = nxt
+                drafts[r.slot] = d
+        return drafts
+
+    def _verify_masks(
+        self,
+        active: list[Request],
+        tokens: np.ndarray,
+        lens: np.ndarray,
+        parents: Optional[np.ndarray] = None,
+    ) -> Optional[np.ndarray]:
+        """Per-position legal-token masks [B, W, V] for one verify
+        dispatch: column j of a constrained slot carries the FSM mask
+        AFTER consuming its (chain-prefix or tree-ancestor) draft path —
+        column 0 is the current state (its token, the pending last
+        token, already advanced the walk at emission time). Padding
+        columns, unconstrained slots, and columns past an FSM-illegal
+        draft token (unreachable: the masked parent logits give the
+        illegal draft probability 0, so it is always rejected) stay
+        all-True. None when no active slot is constrained — the
+        ``legal_mask=None`` jit specialization keeps unconstrained
+        verify dispatches byte-identical."""
+        if not any(r.constraint is not None for r in active):
+            return None
+        B, W = tokens.shape
+        m = np.ones((B, W, self.mcfg.vocab_size), bool)
+        masked = 0
+        for r in active:
+            c = r.constraint
+            if c is None:
+                continue
+            s = r.slot
+            states = np.full(W, -1, np.int64)
+            states[0] = c.state
+            m[s, 0] = c.mask_row()
+            for j in range(1, int(lens[s])):
+                p = int(parents[s, j]) if parents is not None else j - 1
+                ps = int(states[p])
+                nxt = c.peek(int(tokens[s, j]), ps) if ps >= 0 else -1
+                states[j] = nxt
+                if nxt >= 0:
+                    m[s, j] = c.mask_row(nxt)
+            masked += 1
+        self.constraint_stats.masked_steps += 1
+        self.constraint_stats.masked_rows += masked
+        return m
 
     def _build_verify_rows(
         self, reqs: list[Request], drafts: dict[int, list[int]]
@@ -2028,10 +2251,14 @@ class InferenceEngine:
         if not active:
             self._reap()
             return False
-        if not any(drafts.get(r.slot) for r in active):
+        if not any(drafts.get(r.slot) for r in active) and not any(
+            r.constraint is not None for r in active
+        ):
             # Every drafted slot was preempted by the provisioning pass:
             # a verify dispatch would be all padding. Run the plain
             # window instead (it re-provisions to the decode window).
+            # Constrained slots are exempt: even draftless they must
+            # decode through the masked verify program (lens-1 rows).
             self._spec_step = False
             return self._decode_window_all()
         if self._tree:
@@ -2043,9 +2270,13 @@ class InferenceEngine:
                 parents=jnp.asarray(parents),
                 tree_mask=jnp.asarray(words),
             )
+            vmask = self._verify_masks(active, tokens, lens, parents)
         else:
             tokens, lens = self._build_verify_rows(active, drafts)
             tree_kw = {}
+            vmask = self._verify_masks(active, tokens, lens)
+        if vmask is not None:
+            tree_kw["legal_mask"] = jnp.asarray(vmask)
         mask = np.zeros(self.max_batch, bool)
         for r in active:
             mask[r.slot] = True
@@ -2138,9 +2369,15 @@ class InferenceEngine:
             st.accepted += kept
             st.rolled_back += k - kept
             st.emitted += n_emit
-            self._spec.state(r.rid).update(
-                k, kept, self.icfg.speculate_tokens
-            )
+            fr = self._constraint_forced.get(s, 0)
+            if fr:
+                self.constraint_stats.forced_accepted += min(kept, fr)
+            if self._spec is not None:
+                # Constrained-only engines verify without a proposer —
+                # there is no adaptive draft length to steer.
+                self._spec.state(r.rid).update(
+                    k, kept, self.icfg.speculate_tokens
+                )
             if not r.done:
                 # Finished slots skip this: _reap releases everything and
                 # donates only full pages below the (rewound) cursor.
@@ -2280,22 +2517,36 @@ class InferenceEngine:
             st.emitted += n_emit
             st.tree_nodes += k
             st.tree_branch_nodes += max(k - depth, 0)
-            # The adaptive controller steers DEPTH (the chain-equivalent
-            # draft length): drafted = the tree's primary depth, accepted
-            # = the verified path length. Width fills whatever budget the
-            # depth leaves (spec_decode.NgramProposer.propose_tree).
-            self._spec.state(r.rid).update(
-                depth, kept, self.icfg.speculate_tokens
-            )
+            fr = self._constraint_forced.get(s, 0)
+            if fr:
+                self.constraint_stats.forced_accepted += min(kept, fr)
+            if self._spec is not None:
+                # The adaptive controller steers DEPTH (the chain-
+                # equivalent draft length): drafted = the tree's primary
+                # depth, accepted = the verified path length. Width fills
+                # whatever budget the depth leaves
+                # (spec_decode.NgramProposer.propose_tree). Constrained-
+                # only engines verify without a proposer.
+                self._spec.state(r.rid).update(
+                    depth, kept, self.icfg.speculate_tokens
+                )
             if not r.done:
                 self._rollback_slot(r)
 
     def _decode_all(self) -> bool:
         self._roll_window()
+        live = [r for r in self.slots if r is not None and not r.done]
+        if self.constrained and any(
+            r.constraint is not None for r in live
+        ):
+            # Constrained slots decode through the masked verify path
+            # unconditionally (the fused window cannot carry FSM masks);
+            # forced runs make the step multi-token whenever the grammar
+            # allows, and unconstrained co-tenants draft normally.
+            self._spec_step = True
+            return self._verify_all(self._propose_constrained_drafts(live))
         if self._spec is not None and not self._spec_disabled:
-            drafts = self._propose_drafts(
-                [r for r in self.slots if r is not None and not r.done]
-            )
+            drafts = self._propose_drafts(live)
             if drafts is not None:
                 self._spec_step = True
                 return self._verify_all(drafts)
@@ -2385,11 +2636,19 @@ class InferenceEngine:
         dispatch."""
         self._roll_window()
         drafts = None
-        if self._spec is not None and not self._spec_disabled:
-            drafts = self._propose_drafts([
-                r for r in self.slots
-                if r is not None and not r.done and not r.prefill_pending
-            ])
+        dec_cands = [
+            r for r in self.slots
+            if r is not None and not r.done and not r.prefill_pending
+        ]
+        if self.constrained and any(
+            r.constraint is not None for r in dec_cands
+        ):
+            # Constrained decode-phase slots force the mixed VERIFY
+            # program (masked rows; forced runs as free drafts), exactly
+            # as _decode_all forces the pure verify path.
+            drafts = self._propose_constrained_drafts(dec_cands)
+        elif self._spec is not None and not self._spec_disabled:
+            drafts = self._propose_drafts(dec_cands)
         self._grow_pages(
             self.icfg.speculate_tokens + 1 if drafts is not None else None
         )
@@ -2457,10 +2716,16 @@ class InferenceEngine:
             r for r in self.slots
             if r is not None and not r.done and not r.prefill_pending
         ]
-        if drafts is not None and not any(drafts.get(r.slot) for r in dec):
+        if (
+            drafts is not None
+            and not any(drafts.get(r.slot) for r in dec)
+            and not any(r.constraint is not None for r in dec)
+        ):
             # The drafted slot(s) were preempted by this step's page
             # provisioning: nothing left to verify — take the plain
             # 1-token mixed step instead of a padding-only verify.
+            # Constrained decode slots are exempt: draftless or not,
+            # they must ride the masked verify rows.
             drafts = None
         mask = np.array(
             [
@@ -2510,9 +2775,13 @@ class InferenceEngine:
                     parents=jnp.asarray(vparents),
                     tree_mask=jnp.asarray(vwords),
                 )
+                vmask = self._verify_masks(dec, vtok, vlens, vparents)
             else:
                 vtok, vlens = self._build_verify_rows(dec, drafts)
                 tree_kw = {}
+                vmask = self._verify_masks(dec, vtok, vlens)
+            if vmask is not None:
+                tree_kw["legal_mask"] = jnp.asarray(vmask)
             common = (
                 self.params,
                 self.cache,
@@ -2591,6 +2860,8 @@ class InferenceEngine:
             # orion: allow[host-sync] finishing prompts need their sampled first token on the host this step
             for (_, r), first in zip(finishing, np.asarray(firsts)):
                 r.prefill_pending = False
+                if r.done:
+                    continue   # quarantined during mask build
                 if r.max_new_tokens <= 0:
                     r.done = True   # prefill-only (scoring) request
                     continue
@@ -2628,11 +2899,53 @@ class InferenceEngine:
         self._reap()
         return bool(dec)
 
+    def _sample_masks(
+        self, reqs: list[Request], nb: int
+    ) -> Optional[jax.Array]:
+        """Host-built legal-token masks for one single-token sampling
+        dispatch: row i constrains reqs[i]'s next token to its FSM's
+        legal set (all-True for unconstrained slots). Returns None when
+        no live request is constrained — the ``legal_mask=None``
+        specialization keeps unconstrained dispatches byte-identical to
+        a build without this subsystem."""
+        if not any(
+            r.constraint is not None and not r.done for r in reqs
+        ):
+            return None
+        rows = np.ones((nb, self.mcfg.vocab_size), bool)
+        masked = 0
+        for i, r in enumerate(reqs):
+            if r.constraint is None or r.done or i >= nb:
+                continue
+            row = r.constraint.mask_row()
+            if not row.any():
+                # Defense in depth — unreachable through the engine
+                # (dead/complete states finish at advance time, dead
+                # START states are rejected at submit): an all-masked
+                # row would fail the whole dispatch
+                # (sampling.check_legal_mask), so contain just this
+                # slot and leave its row permissive; neighbors sample
+                # exactly what they would have.
+                self.constraint_stats.dead_ends += 1
+                self._quarantine(r, "constraint_all_masked")
+                continue
+            rows[i] = row
+            masked += 1
+        if not masked:
+            return None
+        self.constraint_stats.masked_steps += 1
+        self.constraint_stats.masked_rows += masked
+        return jnp.asarray(rows)
+
     def _sample(
         self, logits: jax.Array, reqs: Optional[list[Request]] = None
     ) -> np.ndarray:
         icfg = self.icfg
         self._key, sub = jax.random.split(self._key)
+        legal = (
+            self._sample_masks(reqs, logits.shape[0])
+            if self.constrained and reqs else None
+        )
         if not any(
             r.temperature is not None or r.top_k is not None
             or r.top_p is not None
@@ -2641,7 +2954,7 @@ class InferenceEngine:
             # All-defaults: python scalars keep the greedy short-circuit.
             toks = sample(
                 logits, sub, temperature=icfg.temperature,
-                top_k=icfg.top_k, top_p=icfg.top_p,
+                top_k=icfg.top_k, top_p=icfg.top_p, legal_mask=legal,
             )
             return np.asarray(jax.device_get(toks))
         # Requests here are admitted (slots assigned), and _admit already
@@ -2661,10 +2974,47 @@ class InferenceEngine:
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
+            legal_mask=legal,
         )
         return np.asarray(jax.device_get(toks))
 
     def _maybe_finish(self, req: Request, tok: int) -> None:
+        # Grammar walk: every emission site funnels through here (the
+        # append + _maybe_finish invariant), so this is the single point
+        # where a constrained request's FSM consumes the token.
+        if req.constraint is not None and not req.done:
+            c = req.constraint
+            t0 = time.perf_counter()
+            # Replay safety: a failover/resubmission may have rebuilt
+            # ``generated`` without walking the FSM — re-sync before the
+            # incremental advance (no-op when the counts agree; ``tok``
+            # is already the last element of ``generated``).
+            ok = c.sync(req.generated[:-1]) and c.advance(int(tok))
+            self.constraint_stats.advance_s += time.perf_counter() - t0
+            if not ok:
+                # Only reachable when something upstream bypassed the
+                # mask — contain like any poisoned slot; neighbors'
+                # outputs stay byte-identical.
+                self.constraint_stats.dead_ends += 1
+                self._quarantine(req, "constraint_illegal_token")
+                return
+            if c.is_dead():
+                # Non-accepting, no legal continuation: the vocab can't
+                # spell the rest of the pattern from here.
+                self.constraint_stats.dead_ends += 1
+                self._quarantine(req, "constraint_dead_end")
+                return
+            if c.is_complete():
+                # Accepting with no continuation: the only legal move is
+                # to stop — finish now instead of burning a step to
+                # sample the forced eos.
+                self.constraint_stats.completed += 1
+                req.done = True
+                return
+            if self.eos_id is not None and tok == self.eos_id:
+                # eos only passes the mask in accepting states: a closed
+                # constrained walk is a completion.
+                self.constraint_stats.completed += 1
         hit_eos = self.eos_id is not None and tok == self.eos_id
         # seq_lens counts tokens whose KV is cached; the just-sampled token
         # is not yet written, and its write position (== seq_lens) must stay

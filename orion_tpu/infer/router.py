@@ -128,6 +128,12 @@ class RouterRequest:
     t_first: Optional[float] = None
     t_last: Optional[float] = None
     slo_seen: int = 0
+    # Grammar constraint (orion_tpu.constrain.ConstraintSpec): part of
+    # the durable identity — every placement hands the SPEC to the
+    # engine, which compiles it (memoized by pattern hash) and builds a
+    # fresh per-attempt walk; a failover's regenerated prefix re-walks
+    # the FSM from the start, so the walk always matches the attempt.
+    constraint: Optional[Any] = None
 
     @property
     def generated(self) -> list[int]:
@@ -396,6 +402,7 @@ class Router:
         top_p: Optional[float] = None,
         deadline_s: Optional[float] = None,
         priority: int = 0,
+        constraint: Optional[Any] = None,
     ) -> RouterRequest:
         """Admit one request to the fleet. Placement is immediate when a
         routable replica exists (engine-side validation errors raise here
@@ -424,6 +431,7 @@ class Router:
                 if deadline_s is not None else None
             ),
             t_submit=time.monotonic(),
+            constraint=constraint,
         )
         if self._tracer.enabled:
             self._tracer.instant(
@@ -966,7 +974,7 @@ class Router:
                 rr.prompt, rr.max_new_tokens,
                 temperature=rr.temperature, top_k=rr.top_k,
                 top_p=rr.top_p, deadline_s=deadline_s,
-                priority=rr.priority,
+                priority=rr.priority, constraint=rr.constraint,
                 # Trace context (ISSUE 14): the router rid is the fleet
                 # trace id; the replica's lifecycle instants and dispatch
                 # spans tag it, so this attempt correlates with the

@@ -820,6 +820,7 @@ def verify_step(
     depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
     parents: Optional[jax.Array] = None,    # [B, W] parent column per col
     tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
+    legal_mask: Optional[jax.Array] = None,  # [B, W, V] constraint masks
 ) -> tuple[jax.Array, ...]:
     """Score K drafts for EVERY live slot in ONE dispatch (speculative
     decoding's verification half; drafting is infer/spec_decode.py).
@@ -856,6 +857,14 @@ def verify_step(
     acceptance becomes the CHILD-indexed tree walk of
     ``sampling.spec_verify_sample_tree``. With all three None this is
     bit-for-bit the chain program.
+
+    ``legal_mask`` (constrained decoding, [B, W, V] bool): the host
+    precomputes position j's legal-token bitmask by walking the FSM
+    along the row's draft prefix (chain) or ancestor path (tree) — the
+    states are known before dispatch because the drafts are — and the
+    mask composes into the SAME filtered target the acceptance math
+    already uses. ``None`` keeps this the unconstrained trace (its own
+    jit specialization), which is what the byte-identity pin tests.
     """
     from orion_tpu.infer.sampling import (
         spec_verify_sample,
@@ -879,11 +888,13 @@ def verify_step(
         accept, alt = spec_verify_sample(
             logits, _draft_next(tokens, lens), key,
             temperature=temperature, top_k=top_k, top_p=top_p,
+            legal_mask=legal_mask,
         )
     else:
         accept, alt = spec_verify_sample_tree(
             logits, tokens, parents, lens, key,
             temperature=temperature, top_k=top_k, top_p=top_p,
+            legal_mask=legal_mask,
         )
     if nan_guard:
         # Per-slot finite check over the row's REAL positions only (padding
@@ -1004,6 +1015,7 @@ def mixed_verify_step(
     depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
     parents: Optional[jax.Array] = None,    # [B, W] parent column per col
     tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
+    legal_mask: Optional[jax.Array] = None,  # [B, W, V] constraint masks
 ) -> tuple[jax.Array, ...]:
     """``mixed_step`` with the decode half replaced by the verify body:
     speculative decoding composed with chunked prefill. One dispatch runs
@@ -1048,11 +1060,13 @@ def mixed_verify_step(
         accept, alt = spec_verify_sample(
             logits, _draft_next(tokens, lens), key,
             temperature=temperature, top_k=top_k, top_p=top_p,
+            legal_mask=legal_mask,
         )
     else:
         accept, alt = spec_verify_sample_tree(
             logits, tokens, parents, lens, key,
             temperature=temperature, top_k=top_k, top_p=top_p,
+            legal_mask=legal_mask,
         )
     p_logits = _prefill_logits(params, xp, p_lengths, cfg)
     if nan_guard:

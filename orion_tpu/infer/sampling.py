@@ -2,14 +2,59 @@
 
 Static-shape TPU formulation: top-k and top-p are masks over the full vocab
 (sort + cumulative sum), never a dynamic-length candidate list.
+
+Constrained decoding (orion_tpu.constrain) composes a per-row legal-token
+bitmask into the SAME filtered distribution every consumer shares: greedy,
+sampled, and both speculative verify paths mask before any filtering, so a
+constrained draft is accepted by exactly the rejection-sampling math the
+unconstrained path runs — no new acceptance rule. ``legal_mask=None``
+keeps every trace byte-identical to the unconstrained build (the jit
+specializes on the None pytree).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
+
+
+class AllMaskedRows(ValueError):
+    """Typed per-slot error: legal-mask rows that admit NO token. The
+    filtered distribution for such a row is undefined (softmax of all
+    NEG_INF is uniform garbage), so the engine must fail the offending
+    slots — and only those slots — before dispatch. ``slots`` lists the
+    guilty row indices; neighbors are unaffected."""
+
+    def __init__(self, slots):
+        self.slots = list(slots)
+        super().__init__(
+            f"legal_mask rows {self.slots} admit no token (constraint "
+            f"dead end); quarantine those slots"
+        )
+
+
+def check_legal_mask(legal_mask) -> None:
+    """Host-side pre-dispatch validation: raise :class:`AllMaskedRows`
+    naming every all-masked row. Rows are the leading axis (flatten
+    [B, W, V] masks to row-major [B*W, V] semantics upstream if per-slot
+    attribution over positions is needed; the engine checks per-slot
+    rows before building verify masks)."""
+    m = np.asarray(legal_mask, bool)
+    rows = m.reshape(-1, m.shape[-1])
+    bad = np.flatnonzero(~rows.any(axis=-1))
+    if bad.size:
+        raise AllMaskedRows(bad.tolist())
+
+
+def _apply_mask(logits: jax.Array, legal_mask) -> jax.Array:
+    """Illegal tokens drop to NEG_INF BEFORE temperature/top-k/top-p so
+    every downstream filter sees the constrained distribution."""
+    if legal_mask is None:
+        return logits
+    return jnp.where(legal_mask, logits.astype(jnp.float32), NEG_INF)
 
 
 def sample(
@@ -19,6 +64,7 @@ def sample(
     temperature=0.0,
     top_k=0,
     top_p=1.0,
+    legal_mask=None,
 ) -> jax.Array:
     """logits: [B, V] -> sampled token ids [B] int32.
 
@@ -28,9 +74,27 @@ def sample(
     batching-equivalence tests rely on). top_k=0 / top_p=1.0 disable the
     respective filters.
 
+    ``legal_mask`` ([B, V] bool or None) constrains rows to their legal
+    tokens: illegal logits drop to NEG_INF before any filter, and a row
+    whose mask admits exactly ONE token short-circuits to that token —
+    deterministically, on BOTH the greedy and sampled paths (a forced
+    continuation must not depend on the sampling mode). All-masked rows
+    are a caller bug; validate with ``check_legal_mask`` pre-dispatch.
+
     The all-scalar greedy case short-circuits to a bare argmax — the bench
     path compiles no sampling machinery.
     """
+    logits = _apply_mask(logits, legal_mask)
+    if legal_mask is not None:
+        forced = jnp.argmax(legal_mask, axis=-1).astype(jnp.int32)
+        single = jnp.sum(legal_mask, axis=-1) == 1
+
+        def finish(toks):
+            return jnp.where(single, forced, toks)
+    else:
+        def finish(toks):
+            return toks
+
     # Trace-time constants (python scalars, e.g. bound via functools.partial
     # before jit) let disabled filters compile to nothing: the greedy bench
     # decode is a bare argmax, plain-temperature sampling skips the [B, V]
@@ -39,11 +103,13 @@ def sample(
     no_topp = isinstance(top_p, (int, float)) and top_p >= 1.0
     if isinstance(temperature, (int, float)):
         if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return finish(jnp.argmax(logits, axis=-1).astype(jnp.int32))
         if no_topk and no_topp:
             scaled = logits.astype(jnp.float32) / temperature
-            return jax.random.categorical(key, scaled, axis=-1).astype(
-                jnp.int32
+            return finish(
+                jax.random.categorical(key, scaled, axis=-1).astype(
+                    jnp.int32
+                )
             )
 
     B, V = logits.shape
@@ -56,7 +122,7 @@ def sample(
     scaled = filter_logits(logits, temp, top_k, top_p,
                            no_topk=no_topk, no_topp=no_topp)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temp > 0, sampled, greedy)
+    return finish(jnp.where(temp > 0, sampled, greedy))
 
 
 def filter_logits(
@@ -67,6 +133,7 @@ def filter_logits(
     *,
     no_topk: bool = False,
     no_topp: bool = False,
+    legal_mask=None,
 ) -> jax.Array:
     """Temperature-scaled, top-k/top-p-masked logits [B, V].
 
@@ -74,9 +141,13 @@ def filter_logits(
     categorical from it, and speculative verification (spec_verify_sample)
     measures draft-acceptance probabilities against softmax of the SAME
     array — rejection sampling preserves the output distribution only if
-    both sides agree on it exactly.
+    both sides agree on it exactly. ``legal_mask`` applies FIRST, so
+    top-k/top-p renormalize over the constrained support (top-k acts as
+    min(k, legal count): the k-th largest of a masked row is NEG_INF
+    once k exceeds the legal count, which keeps every legal token).
     """
     B, V = logits.shape
+    logits = _apply_mask(logits, legal_mask)
     scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
 
     if not (no_topk and no_topp):
@@ -126,6 +197,7 @@ def spec_verify_sample(
     temperature=0.0,
     top_k=0,
     top_p=1.0,
+    legal_mask=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Per-position draft acceptance for speculative decoding.
 
@@ -148,8 +220,17 @@ def spec_verify_sample(
     The all-scalar greedy case (python temperature <= 0) compiles to a bare
     argmax + compare — no sort, no categorical (mirrors ``sample``'s
     specialization contract).
+
+    ``legal_mask`` ([B, W, V] bool or None): position j's mask is the
+    constraint state AFTER consuming the row's draft prefix up to j —
+    masking before filtering makes p the constrained target, so a forced
+    draft (single legal token) has p(draft) exactly 1.0 in f32 (every
+    competitor underflows through exp(NEG_INF)) and u ~ U[0,1) < 1.0
+    accepts it ALWAYS, greedy or sampled: forced runs are free drafts
+    under the unmodified acceptance rule.
     """
     B, W, V = logits.shape
+    logits = _apply_mask(logits, legal_mask)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B, W]
     if isinstance(temperature, (int, float)) and temperature <= 0.0:
         return greedy == draft_next, greedy
@@ -198,6 +279,7 @@ def spec_verify_sample_tree(
     temperature=0.0,
     top_k=0,
     top_p=1.0,
+    legal_mask=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Token-tree draft acceptance (``spec_verify_sample`` generalized
     from a chain to an ancestor tree; SpecInfer-style multi-branch
@@ -228,8 +310,15 @@ def spec_verify_sample_tree(
     single child per node this is rejection sampling against the same
     target as ``spec_verify_sample`` (the draws ride child-indexed keys,
     so the chain STREAM differs while the law does not).
+
+    ``legal_mask`` ([B, W, V] bool or None): column j's mask is the
+    constraint state after consuming j's ANCESTOR path (the distribution
+    j's logits feed) — siblings at an FSM branch point are each legal
+    under their shared parent's mask, so multi-branch rejection sampling
+    covers the branch with the standard elder-sibling renormalization.
     """
     B, W, V = logits.shape
+    logits = _apply_mask(logits, legal_mask)
     steps = jnp.arange(W, dtype=jnp.int32)[None, :]
     valid = (steps >= 1) & (steps < lens[:, None])             # [B, W]
     par = jnp.clip(parents.astype(jnp.int32), 0, W - 1)

@@ -74,6 +74,11 @@ class Request:
     # the slot rides mixed steps as a prompt-chunk row, never a decode row.
     prefill_done: int = 0
     prefill_pending: bool = False
+    # Grammar constraint (orion_tpu.constrain.ConstraintState): the
+    # request's walk through its token DFA. Pure host state — survives
+    # preemption (re-prefill replays prompt + generated; the state
+    # re-syncs off ``generated`` if a failover replayed the request).
+    constraint: Optional[Any] = None
 
     @property
     def context(self) -> list[int]:

@@ -1,0 +1,519 @@
+"""Grammar-constrained decoding: FSM logit masks that amplify
+speculation (ISSUE 16).
+
+The load-bearing properties, mirroring the spec-decode suite:
+
+- EQUIVALENCE OFF: an engine with ``inference.constrained=true`` serving
+  only unconstrained requests is byte-identical to the constrained=false
+  engine on BOTH verify paths (plain decode-window verify and chunked
+  prefill's mixed verify) — the mask plumbing specializes on
+  ``legal_mask=None`` and leaves the unconstrained traces untouched.
+- VALIDITY ON: greedy constrained output is a legal prefix of the
+  grammar at every step — pinned by re-walking every emission through a
+  FRESHLY compiled DFA (property-tested over randomized JSON schemas,
+  not a single hand-picked pattern).
+- AMPLIFICATION: single-choice FSM states ride the verify path as
+  forced drafts with GUARANTEED acceptance (the masked target prob is
+  exactly 1.0), grammar branch points feed the token tree, and rejected
+  tails roll back to the exact window=1 page footprint.
+- FAILURE TYPING: all-masked sampler rows raise a typed per-slot error;
+  a request whose walk hits a dead end is quarantined with a typed
+  outcome while its batch neighbors stay byte-identical.
+"""
+
+import json
+import pathlib
+import random
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import get_config
+from orion_tpu.constrain import (
+    ConstraintError,
+    ConstraintSpec,
+    ConstraintState,
+    compile_constraint,
+    compile_regex,
+    compile_token_dfa,
+    schema_to_regex,
+)
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.infer.sampling import (
+    AllMaskedRows,
+    check_legal_mask,
+    filter_logits,
+    sample,
+)
+from orion_tpu.models import init_params
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+INFER_OVERRIDES = [
+    "inference.max_seq_len=128",
+    "inference.page_size=16",
+    "inference.num_pages=32",
+    "inference.max_batch_size=4",
+    "inference.prefill_chunk=16",
+    "inference.decode_window=1",
+]
+SPEC = ["inference.speculative=true", "inference.speculate_tokens=4"]
+CON = ["inference.constrained=true"]
+
+REP = [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8]
+MIX = [REP, [5, 3, 9, 250, 17], list(range(2, 32))]
+
+
+def _setup(overrides=(), preset="tiny-llama"):
+    cfg = get_config(preset, INFER_OVERRIDES + list(overrides))
+    params = init_params(cfg.model, jax.random.key(0))
+    return cfg, params
+
+
+def _serve(eng, prompts, max_new, specs):
+    reqs = [
+        eng.submit_request(p, max_new, constraint=s)
+        for p, s in zip(prompts, specs)
+    ]
+    while eng.has_work():
+        eng.step()
+    return reqs
+
+
+def _accepts(cdfa, text: str) -> bool:
+    s = 0
+    for b in text.encode("utf-8"):
+        s = cdfa.trans[s].get(b)
+        if s is None:
+            return False
+    return bool(cdfa.accepting[s])
+
+
+# -- compiler units ---------------------------------------------------------
+
+
+def test_regex_compiler_unit():
+    cdfa = compile_regex(r"(ab|cd)+e?")
+    assert _accepts(cdfa, "ab")
+    assert _accepts(cdfa, "abcdab")
+    assert _accepts(cdfa, "cde")
+    assert not _accepts(cdfa, "a")       # legal prefix, not accepting
+    assert not _accepts(cdfa, "e")
+    assert not _accepts(cdfa, "abe x")
+    # Classes, ranges, escapes, bounded repetition.
+    cdfa2 = compile_regex(r'\{"n": -?[0-9]{1,3}\}')
+    assert _accepts(cdfa2, '{"n": -42}')
+    assert _accepts(cdfa2, '{"n": 007}')
+    assert not _accepts(cdfa2, '{"n": 1234}')
+    # Malformed patterns fail with the typed error, not a crash.
+    with pytest.raises(ConstraintError):
+        compile_regex("(ab")
+    with pytest.raises(ConstraintError):
+        compile_regex("a)")
+    # State-cap: a hostile pattern fails at compile, not by OOM.
+    with pytest.raises(ConstraintError, match="state"):
+        compile_regex("[0-9]{100}", max_states=8)
+
+
+def test_schema_frontend_unit():
+    pat = schema_to_regex(
+        {"type": "object", "properties": {
+            "ok": {"type": "boolean"},
+            "n": {"type": "integer"},
+        }}
+    )
+    cdfa = compile_regex(pat)
+    assert _accepts(cdfa, '{"ok":true,"n":-7}')
+    assert _accepts(cdfa, '{"ok":false,"n":0}')
+    assert not _accepts(cdfa, '{"ok":true}')       # all props required
+    assert not _accepts(cdfa, '{"ok": true,"n":1}')  # compact only
+    # enum / const / anyOf / array forms compile and match exactly.
+    assert _accepts(
+        compile_regex(schema_to_regex({"enum": ["a", 3, None]})), '"a"'
+    )
+    assert _accepts(
+        compile_regex(schema_to_regex(
+            {"type": "array", "items": {"type": "integer"},
+             "maxItems": 2}
+        )), "[1,23]",
+    )
+    # JSON text form (what ConstraintSpec carries) parses too.
+    assert schema_to_regex('{"type": "null"}') == "null"
+    # Unsupported / unbounded shapes are typed errors.
+    with pytest.raises(ConstraintError, match="unsupported"):
+        schema_to_regex({"$ref": "#/defs/x"})
+    with pytest.raises(ConstraintError, match="properties"):
+        schema_to_regex({"type": "object"})
+    with pytest.raises(ConstraintError, match="enum"):
+        schema_to_regex({"enum": []})
+    with pytest.raises(ConstraintError, match="JSON"):
+        schema_to_regex("{not json")
+
+
+def test_token_dfa_state_and_cache():
+    from orion_tpu.constrain.dfa import cache_clear
+
+    cache_clear()
+    dfa, hit = compile_token_dfa("a(b|c)", 256)
+    assert hit is False
+    _, hit2 = compile_token_dfa("a(b|c)", 256)
+    assert hit2 is True                      # memoized by pattern hash
+    c = ConstraintState(dfa)
+    # Start admits exactly 'a': a forced (free-draft) state.
+    assert c.mask_choices() == 1
+    assert c.forced_run(4) == [ord("a")]
+    # After 'a': ambiguous — the branch point the tree drafts from.
+    assert c.advance(ord("a"))
+    assert c.branch_tokens(5) == [ord("b"), ord("c")]
+    assert c.forced_run(4) == []
+    # walk/peek never move the cursor; illegal tokens go to -1.
+    assert c.walk([ord("b")]) >= 0
+    assert c.peek(ord("z")) == -1
+    assert c.state == dfa.next_state[dfa.start, ord("a")]
+    # Completion: accepting with no continuation.
+    assert c.advance(ord("c")) and c.is_complete() and not c.is_dead()
+    # sync replays generated after failover; illegal replay reports.
+    assert c.sync([ord("a"), ord("b")]) is True
+    assert c.is_complete()
+    assert c.sync([ord("q")]) is False
+    # eos closes an accepting walk in place and rides the forced run.
+    dfa2, _ = compile_token_dfa("ab", 256)
+    c2 = ConstraintState(dfa2, eos_id=0)
+    c2.sync([ord("a"), ord("b")])
+    assert c2.peek(0) == c2.state
+    assert c2.mask_row()[0]
+    assert c2.forced_run(3) == [0]
+    # Dead end: mid-walk state whose continuation the vocab can't spell
+    # (vocab 64 has digits but no 'x').
+    dfa3, _ = compile_token_dfa("[0-9]x", 64)
+    c3 = ConstraintState(dfa3)
+    assert c3.advance(ord("0"))
+    assert c3.is_dead() and not c3.is_complete()
+    # token_bytes override (multi-byte tokens) bypasses the cache.
+    tb = lambda t: b"ab" if t == 2 else None
+    dfa4, h4 = compile_token_dfa("ab", 4, token_bytes=tb)
+    assert h4 is False
+    assert dfa4.legal[dfa4.start].tolist() == [False, False, True, False]
+    _, h5 = compile_token_dfa("ab", 4, token_bytes=tb)
+    assert h5 is False
+
+
+def test_constraint_spec_and_config_validation():
+    with pytest.raises(ConstraintError, match="exactly one"):
+        ConstraintSpec()
+    with pytest.raises(ConstraintError, match="exactly one"):
+        ConstraintSpec(regex="a", json_schema='{"type": "null"}')
+    with pytest.raises(ConstraintError, match="non-empty"):
+        ConstraintSpec(regex="")
+    spec = ConstraintSpec(json_schema='{"type": "boolean"}')
+    assert spec.pattern() == "(true|false)"
+    assert spec.canonical().startswith("schema:")
+    # Unserveable constraint: no legal first token in this vocab.
+    with pytest.raises(ConstraintError, match="first"):
+        compile_constraint(ConstraintSpec(regex="xyz"), 64)
+    with pytest.raises(ValueError, match="constraint_max_states"):
+        get_config("tiny-llama", ["inference.constraint_max_states=1"])
+    with pytest.raises(ValueError, match="constraint_cache"):
+        get_config("tiny-llama", ["inference.constraint_cache=0"])
+
+
+# -- sampling edge cases ----------------------------------------------------
+
+
+def test_sampling_mask_edges():
+    V = 16
+    logits = np.zeros((2, V), np.float32)
+    logits[:, 3] = 9.0                       # unconstrained argmax: 3
+    lj = jnp.asarray(logits)
+    # All-masked rows are a typed per-slot error naming the guilty rows.
+    bad = np.ones((3, V), bool)
+    bad[1] = False
+    with pytest.raises(AllMaskedRows) as ei:
+        check_legal_mask(bad)
+    assert ei.value.slots == [1]
+    bad3 = np.ones((2, 2, V), bool)          # [B, W, V] flattens row-major
+    bad3[1, 0] = False
+    with pytest.raises(AllMaskedRows) as ei3:
+        check_legal_mask(bad3)
+    assert ei3.value.slots == [2]
+    check_legal_mask(np.ones((2, V), bool))  # no error
+    # Single-legal-token rows short-circuit to the forced token on BOTH
+    # the greedy and sampled paths — identical across keys and filters.
+    mask = np.ones((2, V), bool)
+    mask[1] = False
+    mask[1, 5] = True
+    mj = jnp.asarray(mask)
+    assert sample(lj, jax.random.key(0), temperature=0.0,
+                  legal_mask=mj).tolist() == [3, 5]
+    for seed in range(4):
+        out = sample(lj, jax.random.key(seed), temperature=1.0,
+                     legal_mask=mj)
+        assert int(out[1]) == 5
+        out2 = sample(lj, jax.random.key(seed), temperature=1.0,
+                      top_k=4, top_p=0.9, legal_mask=mj)
+        assert int(out2[1]) == 5
+    # legal_mask=None and an all-True mask define the same distribution.
+    temp = jnp.ones((2,), jnp.float32)
+    tk = jnp.zeros((2,), jnp.int32)
+    tp = jnp.ones((2,), jnp.float32)
+    f0 = filter_logits(lj, temp, tk, tp)
+    f1 = filter_logits(lj, temp, tk, tp, legal_mask=jnp.ones((2, V), bool))
+    np.testing.assert_array_equal(np.asarray(f0), np.asarray(f1))
+    # The mask applies BEFORE top-k: k larger than the legal count keeps
+    # every legal token (the NEG_INF tail is the threshold).
+    f2 = np.asarray(filter_logits(lj, temp, jnp.full((2,), 8, jnp.int32),
+                                  tp, legal_mask=mj))
+    kept = f2[1] > -1e29                     # above the NEG_INF floor
+    assert kept[5] and np.count_nonzero(kept) == 1, f2[1]
+
+
+# -- engine: constrained-off byte-identity ----------------------------------
+
+
+def test_constrained_off_byte_identity_both_verify_paths():
+    """constrained=true with no constrained requests is byte-identical
+    to constrained=false — on the plain verify path and on chunked
+    prefill's MIXED verify path — and builds no masks at all."""
+    _, params = _setup(SPEC)
+    for extra in ([], ["inference.chunked_prefill=true"]):
+        cfg_off, _ = _setup(SPEC + extra)
+        cfg_on, _ = _setup(SPEC + CON + extra)
+        ref = InferenceEngine(cfg_off, params).generate(MIX, 24)
+        eng = InferenceEngine(cfg_on, params)
+        assert eng.generate(MIX, 24) == ref, extra
+        t = eng.reset_timing()
+        assert t["constrain_masked_steps"] == 0, t
+        assert t["constrain_requests"] == 0, t
+
+
+def test_constraint_needs_flag_and_type():
+    cfg, params = _setup(SPEC)       # constrained NOT enabled
+    eng = InferenceEngine(cfg, params)
+    with pytest.raises(ValueError, match="inference.constrained"):
+        eng.submit_request(REP, 8, constraint=ConstraintSpec(regex="ab"))
+    cfg_on, _ = _setup(CON)
+    eng2 = InferenceEngine(cfg_on, params)
+    with pytest.raises(ValueError, match="ConstraintSpec"):
+        eng2.submit_request(REP, 8, constraint="[0-9]+")
+
+
+# -- engine: greedy validity (property over random schemas) -----------------
+
+
+def _legal_prefix(spec, toks, vocab):
+    dfa, _ = compile_constraint(spec, vocab)
+    return ConstraintState(dfa).sync(list(toks))
+
+
+def test_greedy_constrained_always_fsm_valid_random_schemas():
+    """Property: for randomized JSON schemas, every token the greedy
+    constrained engine emits keeps the output a legal prefix of the
+    grammar — audited by re-walking through a fresh compile. Schemas are
+    drawn per-request, so one batch serves four DIFFERENT grammars."""
+    cfg, params = _setup(SPEC + CON)
+    eng = InferenceEngine(cfg, params)
+    leaf = [
+        {"type": "boolean"}, {"type": "integer"}, {"type": "null"},
+        {"enum": ["hi", -3, True]},
+        {"type": "string", "maxLength": 3},
+        {"type": "array", "items": {"type": "boolean"}, "maxItems": 2},
+    ]
+    r = random.Random(16)
+    for round_ in range(2):
+        specs = []
+        for i in range(4):
+            props = {
+                f"k{j}": r.choice(leaf)
+                for j in range(r.randint(1, 3))
+            }
+            specs.append(ConstraintSpec(json_schema=json.dumps(
+                {"type": "object", "properties": props}
+            )))
+        prompts = [[r.randrange(1, 256) for _ in range(5)]
+                   for _ in range(4)]
+        reqs = _serve(eng, prompts, 24, specs)
+        for req, spec in zip(reqs, specs):
+            assert req.outcome == "completed", (round_, req.outcome)
+            assert _legal_prefix(spec, req.generated,
+                                 cfg.model.vocab_size), (
+                round_, spec.json_schema, req.generated
+            )
+    t = eng.reset_timing()
+    assert t["constrain_requests"] == 8, t
+    assert t["constrain_masked_rows"] > 0, t
+    assert t["constrain_dead_ends"] == 0, t
+
+
+# -- engine: forced runs, rollback, tree branching --------------------------
+
+FORCED = ConstraintSpec(regex=r'\{"key": "val", "n": [0-9]{2}\}')
+
+
+def test_forced_runs_free_drafts_and_completion():
+    """Single-choice states ride the verify dispatch as forced drafts
+    with guaranteed acceptance; the closed pattern finishes through
+    is_complete() without burning an extra step."""
+    cfg, params = _setup(SPEC + CON)
+    eng = InferenceEngine(cfg, params)
+    (req,) = _serve(eng, [REP], 48, [FORCED])
+    assert req.outcome == "completed"
+    text = bytes(req.generated).decode()
+    assert text.startswith('{"key": "val", "n": ')
+    assert text.endswith("}") and len(text) == 23
+    t = eng.reset_timing()
+    assert t["constrain_forced_drafted"] > 0, t
+    assert t["constrain_forced_accepted"] == t["constrain_forced_drafted"]
+    assert t["constrain_completed"] == 1, t
+    # The forced run amortizes: far fewer steps than tokens.
+    assert t["steps"] < len(req.generated), t
+
+
+def test_forced_run_rollback_window1_footprint():
+    """Speculative constrained decode never over-holds pages: mid-run
+    every live slot's footprint is exactly the cursor-covering page set
+    (the window=1 footprint), outputs are byte-identical to the
+    speculate_tokens=1 constrained engine, and the allocator drains to
+    the identical state."""
+    cfg_w, params = _setup(SPEC + CON)
+    cfg_1, _ = _setup(CON + ["inference.speculative=true",
+                             "inference.speculate_tokens=1"])
+    prompts = [REP, list(range(2, 32))]
+    specs = [FORCED, FORCED]
+
+    eng = InferenceEngine(cfg_w, params)
+    reqs = [eng.submit_request(p, 32, constraint=s)
+            for p, s in zip(prompts, specs)]
+    while eng.has_work():
+        eng.step()
+        for r in eng.slots:
+            if r is not None and not r.done:
+                want = (int(eng.seq_lens[r.slot]) - 1) // eng.psz + 1
+                assert len(r.pages) == want, (len(r.pages), want)
+    ref = InferenceEngine(cfg_1, params)
+    ref_reqs = _serve(ref, prompts, 32, specs)
+    assert [q.generated for q in reqs] == [q.generated for q in ref_reqs]
+    assert sorted(eng.alloc._free) == sorted(ref.alloc._free)
+    assert eng.alloc._refs == ref.alloc._refs
+
+
+def test_tree_branches_at_fsm_ambiguity():
+    """With spec_tree_width set, ambiguous FSM states become tree branch
+    points (several legal continuations verified in ONE dispatch) and
+    the greedy output stays identical to the chain-mode engine's."""
+    amb = ConstraintSpec(regex=r"(abc|xyz|pqr)[0-9]{2}")
+    cfg_tree, params = _setup(SPEC + CON
+                              + ["inference.spec_tree_width=3"])
+    cfg_chain, _ = _setup(SPEC + CON)
+    prompts = [REP, [5, 3, 9, 250, 17]]
+    eng = InferenceEngine(cfg_tree, params)
+    reqs = _serve(eng, prompts, 16, [amb, amb])
+    t = eng.reset_timing()
+    assert t["constrain_branch_points"] > 0, t
+    assert t["spec_tree_nodes"] > 0, t
+    assert t["constrain_forced_accepted"] > 0, t
+    chain = InferenceEngine(cfg_chain, params)
+    chain_reqs = _serve(chain, prompts, 16, [amb, amb])
+    assert [q.generated for q in reqs] \
+        == [q.generated for q in chain_reqs]
+    for q in reqs:
+        assert bytes(q.generated[:3]).decode() in ("abc", "xyz", "pqr")
+
+
+# -- engine: failure typing -------------------------------------------------
+
+
+def test_dead_end_quarantine_neighbors_unaffected():
+    """A walk that reaches a state the vocab can't continue (vocab 64
+    spells digits but not 'x') is quarantined with a typed outcome; the
+    unconstrained batch neighbor's stream is byte-identical to a solo
+    run. An unserveable constraint (dead START state) is rejected at
+    submit instead, and the engine stays serviceable."""
+    cfg, params = _setup(SPEC + CON + ["model.vocab_size=64"])
+    eng = InferenceEngine(cfg, params)
+    with pytest.raises(ConstraintError, match="first"):
+        eng.submit_request([1, 2, 3], 8,
+                           constraint=ConstraintSpec(regex="xyz"))
+    doomed = eng.submit_request(
+        [1, 2, 3], 8, constraint=ConstraintSpec(regex="[0-9]x")
+    )
+    neighbor = eng.submit_request([5, 6, 7], 8)
+    while eng.has_work():
+        eng.step()
+    assert doomed.outcome == "error:constraint_dead_end"
+    assert len(doomed.generated) == 1          # the digit that led in
+    assert neighbor.outcome == "completed"
+    t = eng.reset_timing()
+    assert t["constrain_dead_ends"] == 1, t
+    assert t["quarantined_requests"] == 1, t
+    solo = InferenceEngine(cfg, params)
+    (ref,) = _serve(solo, [[5, 6, 7]], 8, [None])
+    assert neighbor.generated == ref.generated
+
+
+# -- CLI and bench wiring ---------------------------------------------------
+
+
+def test_generate_cli_constraint_validation():
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    import generate
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        generate.main(["--regex", "ab", "--json-schema", "s.json"])
+    with pytest.raises(SystemExit, match="invalid constraint"):
+        generate.main(["--regex", "(ab"])
+    with pytest.raises(SystemExit, match="json-schema"):
+        generate.main(["--json-schema", "/nonexistent/schema.json"])
+
+
+def test_constrain_bench_smoke():
+    """tools/constrain_bench.py --smoke (the tier-1 wiring): the ISSUE
+    16 acceptance pin as numbers — forced-run tokens > 0 with acceptance
+    exactly 1.0, constrained speculation acceptance >= unconstrained,
+    and every constrained output FSM-legal under a fresh re-compile."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "constrain_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    verdict = lines[-1]
+    assert verdict["constrained_outputs_fsm_legal"] is True, lines
+    assert verdict["forced_run_tokens"] > 0, verdict
+    assert verdict["forced_all_accepted"] is True, verdict
+    assert verdict["constrained_acceptance_ge_freeform"] is True, verdict
+    assert verdict["tokens_per_verify"]["constrained"] \
+        >= verdict["tokens_per_verify"]["freeform"], verdict
+    assert verdict["tree_branch_points"] > 0, verdict
+    assert verdict["no_dead_ends"] is True, verdict
+    by_mode = {d["mode"]: d for d in lines[:-1]}
+    assert by_mode["constrained_spec"]["outcomes"] == ["completed"]
+
+
+def test_serving_bench_structured_smoke():
+    """tools/serving_latency_bench.py --structured --smoke: constrained
+    traffic as its own SLO class — classed burn gauges exist, no SLO
+    breaches, outputs FSM-legal, forced drafts all accepted."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable,
+         str(ROOT / "tools" / "serving_latency_bench.py"),
+         "--structured", "--smoke"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    verdict = lines[-1]
+    assert verdict["all_completed"] is True, lines
+    assert verdict["constrained_outputs_fsm_legal"] is True, lines
+    assert verdict["forced_run_tokens"] > 0, verdict
+    assert verdict["forced_all_accepted"] is True, verdict
+    assert verdict["structured_class_judged"] is True, verdict
+    assert verdict["slo_breaches_mixed"] == 0, verdict

@@ -26,6 +26,12 @@ deadlines. Reports typed-outcome accounting (completed/shed/expired —
 no silent drops), shed rate and shed priorities, accepted-request
 TTFT/ITL percentiles vs an uncontended run, and the worst deadline
 overrun in steps (expiry reaping bounds it at ~1 by construction).
+
+``--structured`` (ISSUE 16): mixed grammar-constrained + free-form
+traffic; structured requests run as their own SLO class and the
+per-class objectives are judged via ``obs.SLOMonitor`` (burn rates in
+the JSON line); the verdict re-validates every constrained output
+against its FSM and reports the forced-run draft tally.
 """
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
@@ -141,9 +147,13 @@ def _serve_outcomes(eng, subs, deadline_s):
     outcome, TTFT, ITL gaps, deadline overrun) and the per-step wall
     times; every submitted request is accounted for (no silent drops)."""
     recs = []
-    for prompt, prio, new in subs:
+    for sub in subs:
+        prompt, prio, new = sub[:3]
+        # Optional 4th element: a ConstraintSpec (--structured traffic).
+        constraint = sub[3] if len(sub) > 3 else None
         req = eng.submit_request(
-            prompt, new, priority=prio, deadline_s=deadline_s
+            prompt, new, priority=prio, deadline_s=deadline_s,
+            constraint=constraint,
         )
         recs.append({
             "req": req, "priority": prio,
@@ -410,6 +420,141 @@ def overload_main(smoke: bool) -> int:
     return 0
 
 
+def structured_main(smoke: bool) -> int:
+    """--structured (ISSUE 16): mixed structured + free-form traffic.
+    Constrained (JSON-schema, grammar-masked) requests run as their own
+    SLO class alongside free-form decodes, and the per-class objectives
+    are JUDGED via obs.SLOMonitor — structured traffic trades raw ITL
+    for validity and forced-run speedup, so it gets its own bar instead
+    of silently burning the interactive class's budget. One JSON line
+    per mode (freeform-only / mixed) plus a verdict line: every
+    constrained output re-validates against the FSM, forced-run draft
+    tokens were produced, and the structured class's SLO judgment ran."""
+    from orion_tpu.config import get_config
+    from orion_tpu.constrain import (
+        ConstraintSpec, ConstraintState, compile_constraint,
+    )
+    from orion_tpu.infer import InferenceEngine
+    from orion_tpu.models import init_params
+    from orion_tpu.obs import bench_metrics_block
+
+    if smoke:
+        preset, base = "tiny-llama", [
+            "inference.max_seq_len=128", "inference.page_size=16",
+            "inference.num_pages=32", "inference.max_batch_size=4",
+            "inference.prefill_chunk=16", "inference.decode_window=1",
+            "inference.constrained=true", "inference.speculative=true",
+            # Structured traffic is SLO class 2; free-form interactive
+            # stays class 1. CPU-smoke bars are generous — the pin is
+            # that the per-class judgment RUNS and a healthy run burns
+            # zero budget, not a wall-clock bar with jit compiles in it.
+            "slo.per_class=2:ttft=120000,itl=60000;"
+            "1:ttft=120000,itl=60000",
+        ]
+        prompt_len, new_tokens, deadline_s = 6, 24, 60.0
+    else:
+        preset, base = "llama-1b-bench", [
+            "model.param_dtype=bfloat16",
+            "inference.max_seq_len=2048", "inference.page_size=64",
+            "inference.num_pages=1024", "inference.max_batch_size=8",
+            "inference.prefill_chunk=256", "inference.decode_window=1",
+            "inference.constrained=true", "inference.speculative=true",
+            # On-chip bars: structured (class 2) tolerates a higher TTFT
+            # (constraint compile on first sight) for the masked-decode
+            # validity guarantee; interactive (class 1) keeps its bar.
+            "slo.per_class=2:ttft=3000,itl=120;1:ttft=2000,itl=100",
+        ]
+        prompt_len, new_tokens, deadline_s = 32, 96, 120.0
+
+    cfg = get_config(preset, base)
+    B = cfg.inference.max_batch_size
+    rng = np.random.default_rng(0)
+    V = cfg.model.vocab_size
+    mk = lambda: rng.integers(1, min(V, 256), prompt_len).tolist()
+    schema = (
+        '{"type": "object", "properties": {'
+        '"ok": {"type": "boolean"}, "n": {"type": "integer"}}}'
+    )
+    spec = ConstraintSpec(json_schema=schema)
+    params = init_params(cfg.model, jax.random.key(0))
+
+    results = {}
+    for mode in ("freeform", "mixed"):
+        eng = InferenceEngine(cfg, params)
+        if mode == "freeform":
+            subs = [(mk(), 1, new_tokens) for _ in range(B)]
+        else:
+            # Half structured (class 2), half free-form (class 1),
+            # interleaved so both classes share every batch.
+            subs = []
+            for i in range(B):
+                if i % 2 == 0:
+                    subs.append((mk(), 2, new_tokens, spec))
+                else:
+                    subs.append((mk(), 1, new_tokens))
+        # Compile pass at the serving shapes (constrained + free rows),
+        # then the timed pass on the same engine.
+        _serve_outcomes(
+            eng, [(mk(), 2, 4, spec), (mk(), 1, 4)], deadline_s
+        )
+        eng.reset_timing()
+        recs, step_times = _serve_outcomes(eng, subs, deadline_s)
+        eng.assert_page_accounting()
+        r = _overload_summary(recs, step_times, mode, slo_cfg=cfg.slo)
+        t = eng.reset_timing()
+        r["metrics"] = bench_metrics_block(eng, timing=t)
+        r["constrain"] = {
+            k: v for k, v in t.items() if k.startswith("constrain_")
+        }
+        # Validity audit: every structured output must re-walk its FSM
+        # (prefix-legal always; fully accepted when it closed the
+        # grammar before hitting its token budget).
+        dfa, _ = compile_constraint(spec, V)
+        valid = True
+        for rec in recs:
+            req = rec["req"]
+            if req.constraint is None:
+                continue
+            body = [
+                tk for tk in req.generated if tk != eng.eos_id
+            ]
+            c = ConstraintState(dfa, eng.eos_id)
+            if not c.sync(body):
+                valid = False
+        r["constrained_outputs_fsm_legal"] = valid
+        results[mode] = r
+        print(json.dumps(r))
+    free, mixed = results["freeform"], results["mixed"]
+    cs = mixed["constrain"]
+    verdict = {
+        "all_completed": (
+            mixed["outcomes"].get("completed", 0) == mixed["offered"]
+        ),
+        "constrained_outputs_fsm_legal":
+            mixed["constrained_outputs_fsm_legal"],
+        # Forced-run amplification: single-choice FSM states produced
+        # free draft tokens, and every one of them was accepted.
+        "forced_run_tokens": cs.get("constrain_forced_drafted", 0),
+        "forced_all_accepted": (
+            cs.get("constrain_forced_accepted", 0)
+            == cs.get("constrain_forced_drafted", 0)
+        ),
+        # The structured class was actually JUDGED: its burn-rate gauges
+        # exist in the SLO block (class 2 keys), and a healthy smoke
+        # burns zero budget in both classes.
+        "structured_class_judged": any(
+            k.startswith("burn_") and k.endswith("_c2")
+            for k in (mixed.get("slo") or {})
+        ),
+        "slo_breaches_mixed": (mixed.get("slo") or {}).get("breaches"),
+        "itl_p99_ratio_mixed_vs_freeform": round(
+            mixed["itl_p99_ms"] / free["itl_p99_ms"], 4
+        ) if free["itl_p99_ms"] else None,
+    }
+    print(json.dumps(verdict))
+    return 0
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv[1:] or "--cpu" in sys.argv[1:]
     # --trace: run the same scenario with the span tracer ON — the
@@ -423,6 +568,8 @@ def main() -> int:
         return 0
     if "--overload" in sys.argv[1:]:
         return overload_main(smoke)
+    if "--structured" in sys.argv[1:]:
+        return structured_main(smoke)
 
     from orion_tpu.config import get_config
     from orion_tpu.infer import InferenceEngine

@@ -83,6 +83,14 @@ QUEUE = [
     # donation bands must hold there too.
     ("contract_grid",
      [sys.executable, str(ROOT / "tools/contract_check.py")], 1800),
+    # Grammar-constrained decoding (ISSUE 16): freeform vs constrained
+    # speculation on a JSON-schema workload — forced-run acceptance
+    # (must be 1.0: the masked target prob on a single-choice state is
+    # exactly 1.0), the constrained-vs-freeform acceptance and
+    # tokens-per-verify columns, and the FSM-validity audit of every
+    # constrained output, on real chips (the --smoke twin rides tier-1).
+    ("constrained",
+     [sys.executable, str(ROOT / "tools/constrain_bench.py")], 1800),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
