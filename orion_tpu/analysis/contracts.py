@@ -968,6 +968,26 @@ def _registry() -> dict[str, Contract]:
         doc="per-request-sampling decode path: same hygiene as defaults",
     )
     add(
+        "host_tier_decode_hygiene", "decode_defaults",
+        overrides=("inference.prefix_cache=true",
+                   "inference.host_tier_bytes=1048576"),
+        predicates=eng_hygiene, smoke=True,
+        doc="host-tier-enabled decode (ISSUE 18): the tiered cache is "
+            "pure host machinery — the compiled decode program gains no "
+            "host callbacks or d2h copies, cache donation still aliased "
+            "(eviction/restore copies live in their own dispatches, "
+            "never on the decode hot path)",
+    )
+    add(
+        "host_tier_verify_hygiene", "verify_defaults",
+        overrides=("inference.prefix_cache=true",
+                   "inference.host_tier_bytes=1048576",
+                   "inference.speculative=true"),
+        predicates=eng_hygiene,
+        doc="host-tier x speculation: the verify dispatch is equally "
+            "untouched by the tier (no callbacks, donation complete)",
+    )
+    add(
         "tp_decode_collectives", "decode_defaults",
         tp=2, devices=2,
         predicates=(

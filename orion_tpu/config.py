@@ -730,6 +730,32 @@ class InferenceConfig:
     # (mapping a 1-page prefix costs table/refcount churn for little gain
     # when page_size is small).
     prefix_cache_min_pages: int = 1
+    # --- Tiered prefix cache (README "Tiered prefix cache") -------------
+    # Host-RAM second tier behind the radix tree: > 0 sizes a HostPagePool
+    # of host_tier_bytes // bytes-per-page slots, and prefix-cache LRU
+    # eviction DEMOTES pages (one batched d2h copies their KV bytes —
+    # int8 scale pools included — into host buffers; the tree keeps the
+    # tokens matchable) instead of discarding. A later match on a
+    # host-resident path restores the pages with one batched h2d and
+    # resumes tail prefill exactly as a warm HBM hit. 0 (default)
+    # disables the tier: the engine is byte-identical to the untiered
+    # one. Requires prefix_cache=true (engine-checked — cross-field).
+    host_tier_bytes: int = 0
+    # Break-even gate: host-resident matches shorter than this many
+    # tokens recompute instead of restoring (counted as
+    # host_recompute_skips). None (default) derives the threshold from
+    # the three measured constants below via the PERF.md "Host-tier
+    # break-even" arithmetic; set it explicitly to pin policy.
+    host_tier_min_tokens: Optional[int] = None
+    # Measured constants feeding the auto threshold (defaults are
+    # conservative PCIe-class numbers; tools/prefix_cache_bench.py
+    # --capacity-sweep reports real ones for the deployment):
+    # sustained h2d bandwidth for the batched restore copy,
+    host_tier_h2d_gbps: float = 8.0
+    # fixed per-restore overhead (dispatch + sync + allocator work),
+    host_tier_restore_overhead_s: float = 0.002
+    # and sustained prefill throughput for the recompute alternative.
+    host_tier_prefill_tok_s: float = 40000.0
     # Chunked prefill (Sarathi-style stall-free batching): admission no
     # longer prefills whole prompts eagerly — pending prompts split at page
     # granularity into chunks of at most prefill_chunk_tokens, and every
@@ -972,6 +998,39 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.constraint_cache={self.constraint_cache} "
                 f"must be >= 1"
+            )
+        if self.host_tier_bytes is None or self.host_tier_bytes < 0:
+            raise ValueError(
+                f"inference.host_tier_bytes={self.host_tier_bytes} must "
+                f"be >= 0 (0 disables the host tier)"
+            )
+        if self.host_tier_min_tokens is not None \
+                and self.host_tier_min_tokens < 0:
+            raise ValueError(
+                f"inference.host_tier_min_tokens="
+                f"{self.host_tier_min_tokens} must be >= 0 (or none for "
+                f"the measured break-even)"
+            )
+        if self.host_tier_h2d_gbps is None or self.host_tier_h2d_gbps <= 0:
+            raise ValueError(
+                f"inference.host_tier_h2d_gbps={self.host_tier_h2d_gbps} "
+                f"must be > 0"
+            )
+        if (
+            self.host_tier_restore_overhead_s is None
+            or self.host_tier_restore_overhead_s < 0
+        ):
+            raise ValueError(
+                f"inference.host_tier_restore_overhead_s="
+                f"{self.host_tier_restore_overhead_s} must be >= 0"
+            )
+        if (
+            self.host_tier_prefill_tok_s is None
+            or self.host_tier_prefill_tok_s <= 0
+        ):
+            raise ValueError(
+                f"inference.host_tier_prefill_tok_s="
+                f"{self.host_tier_prefill_tok_s} must be > 0"
             )
 
 

@@ -33,12 +33,17 @@ import numpy as np
 from orion_tpu.config import Config
 from orion_tpu.infer.executor import DispatchExecutor
 from orion_tpu.infer.kv_cache import (
+    HostPagePool,
     PageAllocator,
     copy_page,
+    gather_pages,
+    host_page_bytes,
+    host_tier_break_even_tokens,
     init_cache,
     pages_per_seq,
     poison_page,
     rollback_pages,
+    scatter_pages,
     scrub_pages,
 )
 from orion_tpu.infer.scheduler import AdmissionQueue, Request, in_flight
@@ -58,6 +63,7 @@ from orion_tpu.obs import (
 from orion_tpu.runtime.fault import (
     DispatchFault,
     FaultInjector,
+    InjectedFault,
     Watchdog,
 )
 
@@ -171,10 +177,76 @@ class InferenceEngine:
         # pages are reclaimable headroom, evicted LRU under pressure.
         self._pcache = None
         self.prefix_stats = PrefixCacheStats()
+        # Host-RAM second tier (inference.host_tier_bytes; README "Tiered
+        # prefix cache"): LRU eviction demotes cached pages into host
+        # buffers (one batched d2h per sweep) instead of discarding, and
+        # a later match on a host-resident path restores them (one
+        # batched h2d) — tail prefill then resumes exactly as a warm HBM
+        # hit. Off (0): everything below stays None and the engine is
+        # byte-identical to the untiered one.
+        self._host_pool: Optional[HostPagePool] = None
+        self._host_min_tokens: float = 0.0
+        if self.icfg.host_tier_bytes > 0:
+            if not self.icfg.prefix_cache:
+                raise ValueError(
+                    "inference.host_tier_bytes > 0 requires "
+                    "inference.prefix_cache=true (the tier lives behind "
+                    "the radix tree)"
+                )
+            pb = host_page_bytes(self.cache, self.mcfg.n_layers)
+            cap = self.icfg.host_tier_bytes // pb
+            if cap < 1:
+                raise ValueError(
+                    f"inference.host_tier_bytes={self.icfg.host_tier_bytes}"
+                    f" is smaller than one page's KV footprint ({pb} "
+                    f"bytes); raise it or disable the tier with 0"
+                )
+            self._host_pool = HostPagePool(cap, page_bytes=pb)
+            self._gather_pages = jax.jit(
+                partial(
+                    gather_pages,
+                    n_layers=self.mcfg.n_layers,
+                    num_pages=self.icfg.num_pages,
+                ),
+            )
+            self._scatter_pages = jax.jit(
+                partial(
+                    scatter_pages,
+                    n_layers=self.mcfg.n_layers,
+                    num_pages=self.icfg.num_pages,
+                ),
+                donate_argnums=(0,),
+            )
+            # Break-even gate: explicit knob wins; otherwise derive from
+            # the measured constants (PERF.md "Host-tier break-even").
+            # None from the arithmetic means restore NEVER wins — the
+            # tier still absorbs evictions (a fleet-warm replica beats a
+            # cold one at placement) but every local hit recomputes.
+            if self.icfg.host_tier_min_tokens is not None:
+                self._host_min_tokens = float(
+                    self.icfg.host_tier_min_tokens
+                )
+            else:
+                auto = host_tier_break_even_tokens(
+                    pb, self.psz,
+                    self.icfg.host_tier_h2d_gbps,
+                    self.icfg.host_tier_restore_overhead_s,
+                    self.icfg.host_tier_prefill_tok_s,
+                )
+                self._host_min_tokens = (
+                    float(auto) if auto is not None else float("inf")
+                )
         if self.icfg.prefix_cache:
             from orion_tpu.infer.prefix_cache import PrefixCache
 
-            self._pcache = PrefixCache(self.psz, self.alloc)
+            self._pcache = PrefixCache(
+                self.psz, self.alloc,
+                host_pool=self._host_pool,
+                spill=(
+                    self._spill_pages if self._host_pool is not None
+                    else None
+                ),
+            )
         self._cow = jax.jit(
             partial(
                 copy_page,
@@ -220,6 +292,8 @@ class InferenceEngine:
             )
         self._dev_span = 0.0
         self._prefill_span = 0.0
+        self._spill_span = 0.0
+        self._restore_span = 0.0
         self.timing = self._zero_timing()
 
         # -- Fault tolerance (runtime/fault.py; README "Robustness") -------
@@ -506,6 +580,18 @@ class InferenceEngine:
             # which sums the real held_pages against the allocator).
             out["cached_pages"] = self._pcache.total_pages
             out["evictable_pages"] = self._pcache.evictable_pages()
+        if self._host_pool is not None:
+            # Host-tier occupancy (inference.host_tier_bytes): slots held
+            # minus free over capacity; host_pages is the tree's marker
+            # count (== capacity - free_slots while only the tree and
+            # in-flight restores hold slots).
+            hp = self._host_pool
+            out["host_capacity"] = hp.capacity
+            out["host_free_slots"] = hp.free_slots
+            out["host_pages"] = self._pcache.host_pages
+            out["host_occupancy"] = (
+                (hp.capacity - hp.free_slots) / hp.capacity
+            )
         return out
 
     @contextlib.contextmanager
@@ -948,6 +1034,8 @@ class InferenceEngine:
             self._watchdog.heartbeat()
         self._dev_span = 0.0
         self._prefill_span = 0.0
+        self._spill_span = 0.0
+        self._restore_span = 0.0
         self._spec_step = False
         self._reap_expired()
         # Reap expired/cancelled slots BEFORE admission so their pages are
@@ -994,7 +1082,15 @@ class InferenceEngine:
         total = time.perf_counter() - t0
         self.timing["device_s"] += self._dev_span
         self.timing["prefill_s"] += self._prefill_span
-        self.timing["host_s"] += total - self._dev_span - self._prefill_span
+        # Host-tier copy spans get their own buckets (the bench derives
+        # real d2h/h2d bandwidth from them); they are neither decode
+        # device time nor scheduler host time.
+        self.timing["spill_s"] += self._spill_span
+        self.timing["restore_s"] += self._restore_span
+        self.timing["host_s"] += (
+            total - self._dev_span - self._prefill_span
+            - self._spill_span - self._restore_span
+        )
         self.timing["steps"] += 1
         if decoded:
             self.timing["windows"] += 1
@@ -1082,6 +1178,10 @@ class InferenceEngine:
             # instead of guessing).
             "mixed_steps": 0, "prefill_chunks": 0,
             "chunk_tokens": 0, "chunk_pad_tokens": 0,
+            # Host-tier copy time: spill_s wraps the batched d2h of each
+            # eviction sweep, restore_s the batched h2d of each restore
+            # (inference.host_tier_bytes; both 0.0 with the tier off).
+            "spill_s": 0.0, "restore_s": 0.0,
         }
 
     def reset_timing(self) -> dict:
@@ -1162,7 +1262,10 @@ class InferenceEngine:
         (_autotune_skip) — the recompile cost is paid once per resize
         either way, but it can no longer cascade into a second, spurious
         resize."""
-        host = step_total - self._dev_span - self._prefill_span
+        host = (
+            step_total - self._dev_span - self._prefill_span
+            - self._spill_span - self._restore_span
+        )
         denom = step_total if step_total > 0 else 1.0
         target = self.icfg.decode_host_share_target
         if (
@@ -1229,7 +1332,17 @@ class InferenceEngine:
             # Mirror _match_prefix's SWA cap: a full-context match is
             # never usable there, so do not advertise it.
             cap = (len(context) - 1) // self.psz
-        pages = self._pcache.peek(context, cap)
+        pages, host, first_host = self._pcache.peek_tiered(context, cap)
+        if host and (
+            self._host_pool is None
+            or host * self.psz < self._host_min_tokens
+        ):
+            # Host-resident span admission would send to recompute (gate
+            # below threshold, or a stale tier with no pool): advertise
+            # only the usable device prefix. Above the threshold the FULL
+            # match advertises — a host-warm replica must beat a cold one
+            # at placement even though its hit pays one h2d.
+            pages = first_host
         if pages < max(self.icfg.prefix_cache_min_pages, 1):
             return 0
         if self.page_window is not None and (
@@ -1315,6 +1428,34 @@ class InferenceEngine:
             f"free-list size {self.alloc.free_pages} != "
             f"{n - 1 - live} (pool {n}, live {live})"
         )
+        if self._host_pool is not None:
+            # Host-tier half of the invariant: at a quiescent point the
+            # tree's HostPage markers are the ONLY owners of host slots
+            # (in-flight restore refs exist only inside the restore
+            # envelope), so each held slot's refcount is its marker count
+            # and the free list holds exactly the rest.
+            hp = self._host_pool
+            hrefs = [0] * hp.capacity
+            for h in self._pcache.held_host_pages():
+                hrefs[h] += 1
+            hbad = [
+                (h, hrefs[h], hp.refcount(h))
+                for h in range(hp.capacity) if hrefs[h] != hp.refcount(h)
+            ]
+            assert not hbad, (
+                f"host slot refcount mismatch (slot, owners, refcount): "
+                f"{hbad[:8]}"
+            )
+            hlive = sum(1 for h in range(hp.capacity) if hrefs[h] > 0)
+            assert hp.free_slots == hp.capacity - hlive, (
+                f"host free-list size {hp.free_slots} != "
+                f"{hp.capacity - hlive} (capacity {hp.capacity}, "
+                f"live {hlive})"
+            )
+            assert self._pcache.host_pages == hlive, (
+                f"host_pages counter {self._pcache.host_pages} != "
+                f"walked marker count {hlive}"
+            )
 
     def generate(
         self,
@@ -1449,6 +1590,177 @@ class InferenceEngine:
             self.prefix_stats.evicted_pages += self._pcache.evict(short)
         return self.alloc.alloc(n)
 
+    # -- host tier (inference.host_tier_bytes; README "Tiered prefix
+    #    cache"): the two batched copy envelopes + the break-even gate ---
+
+    def _spill_pages(self, pages: list[int]) -> Optional[list[int]]:
+        """PrefixCache's spill callback: copy the victim pages' KV bytes
+        (every cache array — int8 scale pools ride along) into host
+        slots. ONE batched d2h serves the whole eviction sweep: one
+        gather dispatch over all victims, one device_get. Returns the
+        host slot ids (one engine-owned ref each, which demote hands to
+        the tree), or None when the tier cannot take them — the caller
+        falls back to discarding, so a spill failure degrades the cache,
+        never the step."""
+        hp = self._host_pool
+        try:
+            hids = hp.alloc(len(pages))
+        except MemoryError:
+            return None
+        n = len(pages)
+        npad = 1 << (n - 1).bit_length()
+        padded = np.zeros(npad, np.int32)
+        padded[:n] = pages
+        try:
+            with self._device_span("spill", "_spill_span"), \
+                    self._tracer.annotation("orion/spill"):
+                blocks = self._gather_pages(self.cache, jnp.asarray(padded))
+                # orion: allow[host-sync] the ONE batched d2h per eviction sweep — the host copy IS the operation
+                blocks = jax.device_get(blocks)
+        # orion: allow[fault-except] spill envelope: ANY copy failure degrades to discard eviction, never a failed step
+        except Exception as e:
+            hp.free(hids)
+            self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path="spill",
+                error=f"{type(e).__name__}: {e}",
+            )
+            log.error("host-tier spill failed (%s); discarding instead", e)
+            return None
+        hp.store(hids, blocks, n)
+        self.prefix_stats.evicted_to_host += n
+        return hids
+
+    def _restore_pages(self, pages: list, node, host_idx: list[int]) -> None:
+        """Restore a matched path's host-resident entries into fresh pool
+        pages with ONE batched h2d, then promote the tree markers to the
+        new device ids — after which the caller maps the match exactly as
+        a warm HBM hit. Runs under the match's lock (the path cannot
+        mutate) with one engine ref per host slot in flight (the slots
+        cannot be reclaimed).
+
+        Failure containment: pool exhaustion while allocating the fresh
+        pages propagates as MemoryError (the admission path defers, as
+        any warm admission does); a fault inside the copy envelope —
+        injected (FaultSpec kind="restore") or real — unwinds BOTH sides
+        completely (fresh pages freed, in-flight refs dropped, tree
+        markers untouched and unpromoted) and raises a typed
+        DispatchFault: a torn restore can never leave a half-promoted
+        path or leak a page on either tier."""
+        hp = self._host_pool
+        hids = [pages[i].hid for i in host_idx]
+        for h in hids:
+            hp.retain(h)
+        n = len(hids)
+        try:
+            fresh = self._alloc_pages(n)
+        except MemoryError:
+            hp.free(hids)
+            raise
+        try:
+            if self._injector is not None and (
+                self._injector.take("restore", self.step_no) is not None
+            ):
+                raise InjectedFault(
+                    f"injected restore fault (step {self.step_no})"
+                )
+            npad = 1 << (n - 1).bit_length()
+            padded = np.zeros(npad, np.int32)
+            padded[:n] = fresh
+            blocks = hp.load(hids)
+            if npad > n:
+                blocks = {
+                    k: np.concatenate(
+                        [v, np.zeros((npad - n,) + v.shape[1:], v.dtype)]
+                    )
+                    for k, v in blocks.items()
+                }
+            with self._device_span("restore", "_restore_span"), \
+                    self._tracer.annotation("orion/restore"):
+                self.cache = self._scatter_pages(
+                    self.cache, jnp.asarray(padded),
+                    {k: jnp.asarray(v) for k, v in blocks.items()},
+                )
+                # orion: allow[host-sync] the ONE batched h2d per restore — a torn copy must surface BEFORE any marker promotes
+                jax.block_until_ready(self.cache)
+        # orion: allow[fault-except] restore envelope: unwind both tiers fully, typed DispatchFault, no torn pages
+        except Exception as e:
+            self.alloc.free(fresh)
+            hp.free(hids)
+            self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path="restore",
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise DispatchFault(
+                "restore", f"{type(e).__name__}: {e}"
+            ) from e
+        self._pcache.promote_path(node, dict(zip(host_idx, fresh)))
+        hp.free(hids)
+        for i, p in zip(host_idx, fresh):
+            pages[i] = p
+        self.prefix_stats.host_hits += 1
+        self.prefix_stats.host_restored_pages += n
+
+    def _resolve_host_match(self, context, cap: int, pages: list, node):
+        """A match() result containing host-resident entries is not yet
+        mappable: either restore the whole match (break-even says the h2d
+        beats recomputing the host span) or re-match truncated at the
+        FIRST host entry (prefill needs a contiguous device prefix —
+        entries past a gap are unusable even if device-resident). The
+        binary choice is exact: restores are all-or-prefix, and the gate
+        compares the host span's token count against the measured
+        threshold."""
+        host_idx = [
+            i for i, p in enumerate(pages) if not isinstance(p, int)
+        ]
+        if (
+            self._host_pool is not None
+            and len(host_idx) * self.psz >= self._host_min_tokens
+        ):
+            try:
+                self._restore_pages(pages, node, host_idx)
+                return pages, node
+            except MemoryError as e:
+                # Pool too tight for the restore right now: fall back to
+                # the device prefix rather than deferring the admission —
+                # recompute always works.
+                log.warning(
+                    "host-tier restore deferred to recompute (%s)", e
+                )
+            except DispatchFault:
+                # The envelope unwound both pools; balance the match
+                # lock too before the typed fault fails the step —
+                # retry re-matches from scratch.
+                self._pcache.unlock(node)
+                raise
+        self.prefix_stats.host_recompute_skips += 1
+        self._pcache.unlock(node)
+        first_host = host_idx[0]
+        if first_host == 0:
+            return [], None
+        return self._pcache.match(context, first_host)
+
+    def offload_prefix_cache(self) -> int:
+        """Demote every evictable device-resident cached page to the host
+        tier (one batched d2h) — the fleet warm-start control: a replica
+        about to scale down / hand off its traffic parks its working set
+        in host RAM, and the router's affinity probe still advertises the
+        prefixes, so the replica wins placement over a cold one and
+        restores on first hit. Also the bench's phase control
+        (tools/prefix_cache_bench.py --capacity-sweep). Returns device
+        pages demoted; 0 with the tier (or the cache) off."""
+        if self._pcache is None or self._host_pool is None:
+            return 0
+        # Runs OUTSIDE step() (step's span flush won't see this), so the
+        # spill span flushes straight into the timing bucket here.
+        self._spill_span = 0.0
+        n = self._pcache.demote(self._pcache.evictable_pages())
+        self.prefix_stats.evicted_pages += n
+        self.timing["spill_s"] += self._spill_span
+        self._spill_span = 0.0
+        return n
+
     def _match_prefix(self, context: list[int]):
         """(n_match, pages, node): longest usable cached prefix of
         ``context``, page-granular, LOCKED against eviction (the caller
@@ -1467,6 +1779,13 @@ class InferenceEngine:
             # accounting submit() checked against.
             cap = (len(context) - 1) // self.psz
         pages, node = self._pcache.match(context, cap)
+        if node is not None and any(not isinstance(p, int) for p in pages):
+            # Host-resident entries in the match: restore them (break-
+            # even permitting) or fall back to the pure-device prefix.
+            # Either way `pages` below holds only mappable device ids.
+            pages, node = self._resolve_host_match(
+                context, cap, pages, node
+            )
         n_match = len(pages)
         ok = n_match >= max(self.icfg.prefix_cache_min_pages, 1)
         if ok and self.page_window is not None:
@@ -1943,6 +2262,26 @@ class InferenceEngine:
             (r for r in self.slots if r is not None and not r.done),
             key=lambda r: (-r.priority, r.admit_seq),
         )
+        # Batched pre-evict: compute the whole pass's page shortfall and
+        # reclaim it in ONE eviction sweep, so a host-tier demotion pays
+        # one batched d2h instead of one per page (the per-page evict(1)
+        # below remains as the fallback for preemption-donated pages).
+        # Tier-off this frees the identical LRU page set the lazy loop
+        # would have, just up front.
+        if self._pcache is not None:
+            need_total = 0
+            for req in by_age:
+                if req.slot is None:
+                    continue
+                pos = int(self.seq_lens[req.slot])
+                last = min(pos + W - 1, self.icfg.max_seq_len - 1)
+                n_need = min(last // self.psz + 1, self.pages_per_seq)
+                need_total += max(n_need - len(req.pages), 0)
+            short = need_total - self.alloc.free_pages
+            if short > 0:
+                self.prefix_stats.evicted_pages += self._pcache.evict(
+                    short
+                )
         for req in by_age:
             if req.slot is None:
                 continue  # preempted earlier in this pass

@@ -32,10 +32,13 @@ in-bounds and slot masking is done with seq_lens alone.
 
 from __future__ import annotations
 
+import itertools
+import math
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from orion_tpu.config import InferenceConfig, ModelConfig
 
@@ -287,6 +290,214 @@ def scrub_pages(
         name: arr.at[layer_rows].set(jnp.zeros((), arr.dtype))
         for name, arr in cache.items()
     }
+
+
+class HostPagePool:
+    """Host-RAM page store: the second tier behind the radix tree.
+
+    ``PageAllocator``'s counterpart for host memory — same refcounted
+    free-list discipline (slots at refcount 1 from ``alloc``, ``retain``
+    adds an owner, ``release`` drops one) plus the two things a HOST tier
+    needs that the device pool does not:
+
+    * byte storage: ``store``/``load`` move page blocks (the per-array
+      ``[n, n_layers, ...]`` stacks that ``gather_pages`` produces) into
+      and out of preallocated numpy buffers, one slot per page. The
+      buffers are allocated lazily on the first ``store`` so the pool
+      never needs the cache dict's dtypes up front, and they are plain
+      pinned-by-the-OS host arrays — no device allocation ever.
+    * its own LRU clock: ``touch`` stamps a slot on every store/load,
+      ``evict_lru`` frees the coldest UNREFERENCED slots. A slot with
+      refcount > 1 is skipped, never reclaimed out from under an extra
+      owner (e.g. an in-flight restore's ref) — the evict-while-
+      referenced refusal.
+
+    One object-store shape serves KV pages today and adapter pages later
+    (ROADMAP LoRA item): nothing here knows what the bytes mean.
+    """
+
+    def __init__(self, capacity: int, page_bytes: int = 0):
+        if capacity < 1:
+            raise ValueError(f"HostPagePool needs capacity >= 1, got {capacity}")
+        self.capacity = capacity
+        self.page_bytes = page_bytes
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._refs: list[int] = [0] * capacity
+        self._stamps: list[int] = [0] * capacity
+        self._clock = itertools.count(1)
+        self._store: dict[str, np.ndarray] = {}
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def refcount(self, hid: int) -> int:
+        return self._refs[hid]
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise MemoryError(
+                f"host page pool exhausted: want {n} slots, have "
+                f"{len(self._free)}"
+            )
+        hids = [self._free.pop() for _ in range(n)]
+        now = next(self._clock)
+        for h in hids:
+            self._refs[h] = 1
+            self._stamps[h] = now
+        return hids
+
+    def retain(self, hid: int) -> None:
+        assert 0 <= hid < self.capacity, hid
+        assert self._refs[hid] > 0, f"retain of free host slot {hid}"
+        self._refs[hid] += 1
+
+    def release(self, hid: int) -> bool:
+        """Drop one ownership ref; returns True iff the slot was freed."""
+        assert 0 <= hid < self.capacity, hid
+        assert self._refs[hid] > 0, f"release of free host slot {hid}"
+        self._refs[hid] -= 1
+        if self._refs[hid] == 0:
+            self._free.append(hid)
+            return True
+        return False
+
+    def free(self, hids: list[int]) -> None:
+        """Bulk release for owners holding one ref per slot."""
+        for h in hids:
+            self.release(h)
+
+    def touch(self, hid: int) -> None:
+        self._stamps[hid] = next(self._clock)
+
+    def evict_lru(self, n: int) -> list[int]:
+        """Free up to ``n`` of the coldest single-owner slots.
+
+        Only slots at refcount exactly 1 are reclaimable: a second ref
+        means someone (an in-flight restore, a future adapter mapping)
+        is actively relying on the bytes, and evicting those would tear
+        them — such slots are skipped, not stolen. Returns the freed
+        slot ids; the CALLER owns dropping its tree/table entries for
+        them (this pool knows nothing about the radix tree).
+        """
+        if n <= 0:
+            return []
+        victims = sorted(
+            (h for h in range(self.capacity) if self._refs[h] == 1),
+            key=lambda h: self._stamps[h],
+        )[:n]
+        for h in victims:
+            self.release(h)
+        return victims
+
+    def store(self, hids: list[int], blocks: dict[str, np.ndarray],
+              n: Optional[int] = None) -> None:
+        """Copy the first ``n`` rows of each per-array page block into the
+        given slots (``blocks`` row i -> ``hids[i]``). Rows past ``n`` are
+        dispatch padding (scratch-page gathers) and are dropped here —
+        padding never occupies host RAM."""
+        n = len(hids) if n is None else n
+        assert n <= len(hids), (n, len(hids))
+        rows = list(hids[:n])
+        now = next(self._clock)
+        for name, blk in blocks.items():
+            blk = np.asarray(blk)
+            buf = self._store.get(name)
+            if buf is None:
+                buf = np.empty((self.capacity,) + blk.shape[1:], blk.dtype)
+                self._store[name] = buf
+            buf[rows] = blk[:n]
+        for h in rows:
+            self._stamps[h] = now
+
+    def load(self, hids: list[int]) -> dict[str, np.ndarray]:
+        """Stack the given slots' bytes into per-array page blocks
+        (row i = ``hids[i]``), shaped for ``scatter_pages``."""
+        rows = list(hids)
+        now = next(self._clock)
+        for h in rows:
+            self._stamps[h] = now
+        return {name: buf[rows] for name, buf in self._store.items()}
+
+
+def gather_pages(
+    cache: Cache, pages: jax.Array, *, n_layers: int, num_pages: int
+) -> Cache:
+    """Gather whole pool pages (all layers, all cache arrays) into dense
+    per-array blocks ``[n, n_layers, ...]`` — the device half of the ONE
+    batched d2h an eviction sweep performs. ``pages`` may contain scratch
+    page 0 as padding (one jit program per pow2 batch size); padding rows
+    gather scratch bytes, which the caller drops before storing. Scale
+    pools under kv_quant ride along because the gather walks the whole
+    cache dict. No donation: the pool is read, not consumed."""
+    rows = (
+        pages[:, None].astype(jnp.int32)
+        + jnp.arange(n_layers, dtype=jnp.int32)[None, :] * num_pages
+    )
+    return {name: arr[rows] for name, arr in cache.items()}
+
+
+def scatter_pages(
+    cache: Cache, pages: jax.Array, blocks: Cache,
+    *, n_layers: int, num_pages: int,
+) -> Cache:
+    """Scatter dense page blocks (``gather_pages``' shape) back into the
+    pool pages — the device half of the ONE batched h2d a restore
+    performs. Padding entries target scratch page 0 (never read; repeated
+    scatter indices land arbitrarily but harmlessly there). The engine
+    jits this with the pool donated: restore rewrites rows in place."""
+    rows = (
+        pages[:, None].astype(jnp.int32)
+        + jnp.arange(n_layers, dtype=jnp.int32)[None, :] * num_pages
+    )
+    return {
+        name: arr.at[rows].set(blocks[name].astype(arr.dtype))
+        for name, arr in cache.items()
+    }
+
+
+def host_page_bytes(cache: Cache, n_layers: int) -> int:
+    """Host bytes one pool page occupies across every cache array (all
+    layers; scale pools included under kv_quant) — the unit the
+    ``inference.host_tier_bytes`` budget is divided by."""
+    total = 0
+    for arr in cache.values():
+        per_row = math.prod(arr.shape[1:]) * arr.dtype.itemsize
+        total += n_layers * per_row
+    return total
+
+
+def host_tier_break_even_tokens(
+    page_bytes: int,
+    page_size: int,
+    h2d_gbps: float,
+    restore_overhead_s: float,
+    prefill_tok_s: float,
+) -> Optional[int]:
+    """Break-even match length: the token count above which restoring a
+    host-resident prefix beats recomputing it (PERF.md "Host-tier
+    break-even").
+
+        restore(t)   = overhead + t * bytes_per_token / (bw * 1e9)
+        recompute(t) = t / prefill_tok_s
+
+    Both are linear in t; restore pays a fixed dispatch/sync overhead but
+    a (typically much) cheaper per-token slope, so the lines cross at
+
+        t* = overhead / (1/prefill_tok_s - bytes_per_token/bw)
+
+    Returns ``None`` when the restore slope is >= the recompute slope
+    (restore NEVER wins — e.g. a slow interconnect against a tiny model);
+    otherwise the crossing, floored at one page so a sub-page match never
+    qualifies. The constants are config knobs with measured defaults
+    (``tools/prefix_cache_bench.py --capacity-sweep`` reports real ones).
+    """
+    per_tok_restore = (page_bytes / page_size) / (h2d_gbps * 1e9)
+    per_tok_compute = 1.0 / prefill_tok_s
+    if per_tok_restore >= per_tok_compute:
+        return None
+    gain = per_tok_compute - per_tok_restore
+    return max(page_size, math.ceil(restore_overhead_s / gain))
 
 
 def copy_page(cache: Cache, src, dst, *, n_layers: int, num_pages: int) -> Cache:

@@ -82,6 +82,14 @@ class PrefixCacheStats:
     count admissions, cached_tokens the prompt tokens served from shared
     pages instead of prefill FLOPs, evicted/inserted/cow pages the pool
     churn the cache itself causes.
+
+    Host-tier counters (inference.host_tier_bytes > 0):
+    ``evicted_to_host`` pages demoted to host RAM instead of discarded (a
+    subset of ``evicted_pages``), ``host_hits`` admissions that restored a
+    host-resident path, ``host_restored_pages`` the pages those restores
+    copied back, ``host_recompute_skips`` host-resident matches the
+    break-even gate (or a full host pool / restore failure) sent to
+    recompute instead.
     """
 
     hits: int = 0
@@ -90,6 +98,10 @@ class PrefixCacheStats:
     inserted_pages: int = 0
     evicted_pages: int = 0
     cow_pages: int = 0
+    evicted_to_host: int = 0
+    host_hits: int = 0
+    host_restored_pages: int = 0
+    host_recompute_skips: int = 0
 
     @property
     def hit_rate(self) -> float:
@@ -106,6 +118,10 @@ class PrefixCacheStats:
             "inserted_pages": self.inserted_pages,
             "evicted_pages": self.evicted_pages,
             "cow_pages": self.cow_pages,
+            "evicted_to_host": self.evicted_to_host,
+            "host_hits": self.host_hits,
+            "host_restored_pages": self.host_restored_pages,
+            "host_recompute_skips": self.host_recompute_skips,
         }
 
 

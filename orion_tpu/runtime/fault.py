@@ -306,6 +306,11 @@ class FaultSpec:
         as a genuinely exhausted pool would.
       - "stall":    sleep ``stall_s`` inside the dispatch path (trips the
         engine watchdog when stall_s > inference.watchdog_timeout_s).
+      - "restore":  the next host-tier restore this step raises
+        InjectedFault INSIDE the copy envelope — after the fresh device
+        pages were allocated and the in-flight host refs taken —
+        exercising the envelope's full unwind (both pools balanced, tree
+        markers unpromoted, typed DispatchFault fails the step).
 
     Training-path kinds (Trainer(..., fault_injector=...); ``step`` is the
     trainer step, ``path`` is "train"):
@@ -360,6 +365,7 @@ class FaultSpec:
     def __post_init__(self):
         if self.kind not in (
             "dispatch", "nan", "pool", "stall", "partial_write",
+            "restore",
         ) + self.REPLICA_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.count < 1:

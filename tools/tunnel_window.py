@@ -91,6 +91,15 @@ QUEUE = [
     # constrained output, on real chips (the --smoke twin rides tier-1).
     ("constrained",
      [sys.executable, str(ROOT / "tools/constrain_bench.py")], 1800),
+    # Tiered prefix cache (ISSUE 18): device-warm vs host-warm vs
+    # recompute TTFT across shrinking HBM pools, with the REAL d2h/h2d
+    # bandwidth measured from the spill/restore copy spans — those two
+    # numbers (plus the restore overhead) are the break-even constants
+    # PERF.md's host_tier_min_tokens arithmetic is parameterised by;
+    # nonzero exit on a warm < host < recompute ordering inversion.
+    ("prefix_tier",
+     [sys.executable, str(ROOT / "tools/prefix_cache_bench.py"),
+      "--capacity-sweep"], 1800),
 ]
 
 LOG = ROOT / "TUNNEL_RUNS.jsonl"
