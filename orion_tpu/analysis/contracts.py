@@ -956,6 +956,29 @@ def _registry() -> dict[str, Contract]:
         doc="mixed decode+chunk dispatch: hygiene + cache donation",
     )
     add(
+        "long_prefill_hygiene", "mixed_defaults",
+        overrides=("inference.chunked_prefill=true",
+                   "inference.long_context=true",
+                   "inference.host_tier_bytes=1048576",
+                   "model.sliding_window=32"),
+        predicates=eng_hygiene + (
+            # The page walk is scalar metadata, not communication: a
+            # single-replica long-context mixed program schedules ZERO
+            # collectives, exactly like its short-context twin.
+            collective_inventory(
+                all_gather=0, reduce_scatter=0, all_reduce=0,
+                collective_permute=0, all_to_all=0,
+            ),
+        ),
+        smoke=True,
+        doc="long-context serving (ISSUE 19): the mixed chunk+decode "
+            "program under long_context + SWA gains no host callbacks, "
+            "d2h copies, finiteness ops or collectives from the "
+            "per-request paging machinery — demote/restore copies live "
+            "in their own dispatches, never in the compiled step; cache "
+            "donation still aliased",
+    )
+    add(
         "mixed_verify_hygiene", "mixed_verify_defaults",
         overrides=("inference.chunked_prefill=true",
                    "inference.speculative=true"),

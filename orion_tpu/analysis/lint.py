@@ -155,6 +155,9 @@ _DISPATCH_SCOPE = {
         # run from admission/eviction inside step — their single syncs
         # are the documented one-copy points (justified allows).
         "_spill", "_restore", "_resolve_host", "offload_prefix",
+        # Per-request KV paging (ISSUE 19): the batched page-in restore
+        # is the same documented one-h2d envelope.
+        "_page_in",
     ),
 }
 

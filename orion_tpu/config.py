@@ -774,6 +774,35 @@ class InferenceConfig:
     # resumed chunk starts page-aligned, reusing the prefix-cache
     # mid-sequence prefill path unchanged).
     prefill_chunk_tokens: int = 256
+    # --- Long context (README "Long context") ---------------------------
+    # Blockwise paged-flash prefill (pallas kernel path only): chunk
+    # queries attend the paged KV history directly on a (slot, q_block,
+    # page) grid with the chunk's pages written in-kernel, instead of the
+    # XLA body's dense prefix gather + scatter — per-chunk HBM traffic
+    # O(real context) instead of O(padded gather copy), per-dispatch VMEM
+    # bounded by the page block. On by default: with kernels="xla" (or
+    # paged_prefill=false) the reference body runs unchanged, and the
+    # dispatch fallback ladder always retries on that reference body.
+    paged_prefill: bool = True
+    # Long-context serving (requires chunked_prefill + host_tier_bytes >
+    # 0, engine-checked): admits requests whose worst-case page count
+    # exceeds the device pool, provided their LIVE footprint fits —
+    # sliding-window layers roll pages off as the chunk cursor advances,
+    # and a request's cold completed-chunk pages page out to the host
+    # tier between its turns (restored ahead of the chunks/decode steps
+    # that need them). Preemption of a long request spills its pages to
+    # host instead of recomputing from scratch when the spilled span
+    # clears the host_tier_min_tokens break-even. Off by default: every
+    # admission decision is byte-identical to today's engine.
+    long_context: bool = False
+    # Device-residency budget per long request, in pages. While a
+    # long_context request is mid-prefill with more live pages than this,
+    # its coldest completed-chunk pages demote to the host tier after its
+    # chunk and restore (one batched h2d) just before its next turn —
+    # bounding the device pages a single long context pins between its
+    # chunks so co-tenants keep admitting. 0 (default) disables the
+    # residency cap: pages move to host only on preemption.
+    request_resident_pages: int = 0
     # Speculative decoding (draft-model-free): a host-side prompt-lookup /
     # n-gram proposer (infer/spec_decode.py) drafts up to speculate_tokens
     # continuation tokens per request from the request's OWN prompt+output
@@ -1031,6 +1060,15 @@ class InferenceConfig:
             raise ValueError(
                 f"inference.host_tier_prefill_tok_s="
                 f"{self.host_tier_prefill_tok_s} must be > 0"
+            )
+        if (
+            self.request_resident_pages is None
+            or self.request_resident_pages < 0
+        ):
+            raise ValueError(
+                f"inference.request_resident_pages="
+                f"{self.request_resident_pages} must be >= 0 (0 disables "
+                f"the per-request residency cap)"
             )
 
 

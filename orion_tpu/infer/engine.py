@@ -136,6 +136,25 @@ class InferenceEngine:
                 f"must be a positive multiple of page_size={self.psz} "
                 f"(chunks split at page granularity)"
             )
+        # Long-context serving (inference.long_context; README "Long
+        # context"): per-request KV paging to the host tier + lazy page
+        # provisioning under chunked prefill. Cross-field checks live
+        # here per the config lint rule (dotted overrides apply one
+        # field at a time).
+        self._long = self.icfg.long_context
+        if self._long:
+            if not self.chunked:
+                raise ValueError(
+                    "inference.long_context=true requires "
+                    "inference.chunked_prefill=true (over-pool contexts "
+                    "prefill through page-aligned chunks)"
+                )
+            if self.icfg.host_tier_bytes <= 0:
+                raise ValueError(
+                    "inference.long_context=true requires "
+                    "inference.host_tier_bytes > 0 (per-request paging "
+                    "needs somewhere to page to)"
+                )
 
         self.cache = init_cache(self.mcfg, self.icfg)
         # Tensor-parallel serving on the Pallas path: the kernels run under
@@ -187,11 +206,12 @@ class InferenceEngine:
         self._host_pool: Optional[HostPagePool] = None
         self._host_min_tokens: float = 0.0
         if self.icfg.host_tier_bytes > 0:
-            if not self.icfg.prefix_cache:
+            if not (self.icfg.prefix_cache or self._long):
                 raise ValueError(
                     "inference.host_tier_bytes > 0 requires "
                     "inference.prefix_cache=true (the tier lives behind "
-                    "the radix tree)"
+                    "the radix tree) or inference.long_context=true "
+                    "(per-request paging owns its slots directly)"
                 )
             pb = host_page_bytes(self.cache, self.mcfg.n_layers)
             cap = self.icfg.host_tier_bytes // pb
@@ -290,10 +310,17 @@ class InferenceEngine:
                 f"decode_window_max={self.icfg.decode_window_max} < "
                 f"decode_window={self.icfg.decode_window}"
             )
+        # Lazy chunk provisioning (the over-pool admission path): only
+        # meaningful with a sliding window — a full-attention chunk reads
+        # its WHOLE history from the pool, so its device working set is
+        # O(context) no matter how pages move (the typed
+        # "shed:context_too_long" outcome covers that case instead).
+        self._lazy = self._long and self.page_window is not None
         self._dev_span = 0.0
         self._prefill_span = 0.0
         self._spill_span = 0.0
         self._restore_span = 0.0
+        self._pagein_span = 0.0
         self.timing = self._zero_timing()
 
         # -- Fault tolerance (runtime/fault.py; README "Robustness") -------
@@ -437,6 +464,24 @@ class InferenceEngine:
             tp = self.mesh.shape["tp"] if self.mesh is not None else 1
             check_verify_fit(
                 self.icfg.speculate_tokens + 1,
+                n_heads=self.mcfg.n_heads // tp,
+                n_kv_heads=self.mcfg.n_kv_heads // tp,
+                head_dim=self.mcfg.resolved_head_dim,
+                page_size=self.psz,
+                kv_quant=self.icfg.kv_quant,
+                dtype_itemsize=jnp.dtype(self.mcfg.dtype).itemsize,
+            )
+        if self.icfg.paged_prefill and resolve_impl(self.mcfg.kernels)[0]:
+            # Same init-time VMEM gate for the paged-flash prefill
+            # kernel: its blocks are page-sized (one page of queries x
+            # the GQA group), so the failure mode is a too-large
+            # page_size, named here instead of a Mosaic OOM mid-chunk.
+            from orion_tpu.ops.pallas.paged_flash_prefill import (
+                check_prefill_fit,
+            )
+
+            tp = self.mesh.shape["tp"] if self.mesh is not None else 1
+            check_prefill_fit(
                 n_heads=self.mcfg.n_heads // tp,
                 n_kv_heads=self.mcfg.n_kv_heads // tp,
                 head_dim=self.mcfg.resolved_head_dim,
@@ -588,10 +633,22 @@ class InferenceEngine:
             hp = self._host_pool
             out["host_capacity"] = hp.capacity
             out["host_free_slots"] = hp.free_slots
-            out["host_pages"] = self._pcache.host_pages
+            if self._pcache is not None:
+                out["host_pages"] = self._pcache.host_pages
             out["host_occupancy"] = (
                 (hp.capacity - hp.free_slots) / hp.capacity
             )
+            if self._long:
+                # Residency gauges (inference.long_context): host slots
+                # held by live REQUESTS (engine-owned refs, not tree
+                # markers) over the tier's capacity.
+                held = sum(
+                    len(r.host_pages)
+                    for r in itertools.chain(self.slots, self.waiting)
+                    if r is not None
+                )
+                out["request_host_pages"] = held
+                out["residency_occupancy"] = held / hp.capacity
         return out
 
     @contextlib.contextmanager
@@ -760,6 +817,7 @@ class InferenceEngine:
             r.done = True
             r.outcome = "expired"
             self.robust.expired += 1
+            self._drop_host_pages(r)
             self._just_finished.append(r)
         for r in self.slots:
             if (
@@ -921,12 +979,26 @@ class InferenceEngine:
         # _worst_admission_need).
         needed = self._worst_admission_need(len(prompt), max_context)
         usable = self.icfg.num_pages - 1
+        shed_kind = None
         if needed > usable:
-            raise ValueError(
-                f"request needs up to {needed} KV pages but the pool only "
-                f"has {usable}; raise inference.num_pages or lower "
-                f"max_new_tokens"
-            )
+            if self._lazy and self._long_admission_need() <= usable:
+                # Over-pool long context (inference.long_context + SWA +
+                # chunked prefill): the LAZY working set fits — pages
+                # materialize per chunk and die behind the window, so the
+                # pool never holds the O(context) footprint at once.
+                pass
+            elif self._long:
+                # Long-context mode refuses infeasible work with a TYPED
+                # outcome instead of a raw raise: the caller/router sees
+                # "shed:context_too_long" surface from step() exactly
+                # like an overload shed (RobustnessStats.shed_context).
+                shed_kind = "context_too_long"
+            else:
+                raise ValueError(
+                    f"request needs up to {needed} KV pages but the pool "
+                    f"only has {usable}; raise inference.num_pages or "
+                    f"lower max_new_tokens"
+                )
         if deadline_s is None:
             deadline_s = self.icfg.default_deadline_s
         if deadline_s is not None and deadline_s <= 0:
@@ -954,6 +1026,14 @@ class InferenceEngine:
                 max_new_tokens=req.max_new_tokens,
                 deadline_s=deadline_s, **self._trace_ctx(req),
             )
+        if shed_kind is not None:
+            self._shed(
+                req,
+                f"context needs up to {needed} KV pages, pool has "
+                f"{usable} and the lazy working set does not fit",
+                kind=shed_kind,
+            )
+            return req
         if self.draining:
             # Admission is stopped (SIGTERM drain): typed shed, never
             # queued — the caller still sees the request surface.
@@ -981,12 +1061,17 @@ class InferenceEngine:
     # shed) by drain(). See infer/scheduler.py.
     _in_flight = staticmethod(in_flight)
 
-    def _shed(self, req: Request, why: str) -> None:
+    def _shed(
+        self, req: Request, why: str, kind: Optional[str] = None
+    ) -> None:
         log.warning("shedding request %d (priority %d): %s",
                     req.rid, req.priority, why)
         req.done = True
-        req.outcome = "shed"
+        req.outcome = "shed" if kind is None else f"shed:{kind}"
         self.robust.shed += 1
+        if kind == "context_too_long":
+            self.robust.shed_context += 1
+        self._drop_host_pages(req)
         self._just_finished.append(req)
 
     def cancel(self, rid: int) -> bool:
@@ -1001,6 +1086,7 @@ class InferenceEngine:
                 r.done = True
                 r.outcome = "cancelled"
                 self.robust.cancelled += 1
+                self._drop_host_pages(r)
                 self._just_finished.append(r)
                 return True
         for r in self.slots:
@@ -1036,6 +1122,7 @@ class InferenceEngine:
         self._prefill_span = 0.0
         self._spill_span = 0.0
         self._restore_span = 0.0
+        self._pagein_span = 0.0
         self._spec_step = False
         self._reap_expired()
         # Reap expired/cancelled slots BEFORE admission so their pages are
@@ -1087,9 +1174,10 @@ class InferenceEngine:
         # device time nor scheduler host time.
         self.timing["spill_s"] += self._spill_span
         self.timing["restore_s"] += self._restore_span
+        self.timing["page_in_s"] += self._pagein_span
         self.timing["host_s"] += (
             total - self._dev_span - self._prefill_span
-            - self._spill_span - self._restore_span
+            - self._spill_span - self._restore_span - self._pagein_span
         )
         self.timing["steps"] += 1
         if decoded:
@@ -1181,7 +1269,10 @@ class InferenceEngine:
             # Host-tier copy time: spill_s wraps the batched d2h of each
             # eviction sweep, restore_s the batched h2d of each restore
             # (inference.host_tier_bytes; both 0.0 with the tier off).
-            "spill_s": 0.0, "restore_s": 0.0,
+            # page_in_s is the per-request paging h2d (inference.
+            # long_context): restores of a live request's own host-
+            # resident pages ahead of the dispatch that reads them.
+            "spill_s": 0.0, "restore_s": 0.0, "page_in_s": 0.0,
         }
 
     def reset_timing(self) -> dict:
@@ -1203,7 +1294,9 @@ class InferenceEngine:
         volume, forced-run draft/accept tally, completions/dead ends)."""
         out, self.timing = self.timing, self._zero_timing()
         out["decode_window"] = self.decode_window
-        if self._pcache is not None:
+        # prefix_stats also carries the per-request paging counters
+        # (request_paged_out/in), which exist without a prefix tree.
+        if self._pcache is not None or self._long:
             out.update(self.prefix_stats.as_timing())
             self.prefix_stats = PrefixCacheStats()
         if self._spec is not None:
@@ -1264,7 +1357,7 @@ class InferenceEngine:
         resize."""
         host = (
             step_total - self._dev_span - self._prefill_span
-            - self._spill_span - self._restore_span
+            - self._spill_span - self._restore_span - self._pagein_span
         )
         denom = step_total if step_total > 0 else 1.0
         target = self.icfg.decode_host_share_target
@@ -1429,15 +1522,22 @@ class InferenceEngine:
             f"{n - 1 - live} (pool {n}, live {live})"
         )
         if self._host_pool is not None:
-            # Host-tier half of the invariant: at a quiescent point the
-            # tree's HostPage markers are the ONLY owners of host slots
-            # (in-flight restore refs exist only inside the restore
-            # envelope), so each held slot's refcount is its marker count
-            # and the free list holds exactly the rest.
+            # Host-tier half of the invariant: at a quiescent point host
+            # slots are owned by the tree's HostPage markers plus live
+            # requests' host_pages maps (inference.long_context — one
+            # ENGINE-owned ref each; in-flight restore refs exist only
+            # inside the restore envelope), so each held slot's refcount
+            # is its owner count and the free list holds exactly the rest.
             hp = self._host_pool
             hrefs = [0] * hp.capacity
-            for h in self._pcache.held_host_pages():
-                hrefs[h] += 1
+            tlive = 0
+            if self._pcache is not None:
+                for h in self._pcache.held_host_pages():
+                    hrefs[h] += 1
+                tlive = sum(1 for h in range(hp.capacity) if hrefs[h] > 0)
+            for req in owners:
+                for h in req.host_pages.values():
+                    hrefs[h] += 1
             hbad = [
                 (h, hrefs[h], hp.refcount(h))
                 for h in range(hp.capacity) if hrefs[h] != hp.refcount(h)
@@ -1452,10 +1552,11 @@ class InferenceEngine:
                 f"{hp.capacity - hlive} (capacity {hp.capacity}, "
                 f"live {hlive})"
             )
-            assert self._pcache.host_pages == hlive, (
-                f"host_pages counter {self._pcache.host_pages} != "
-                f"walked marker count {hlive}"
-            )
+            if self._pcache is not None:
+                assert self._pcache.host_pages == tlive, (
+                    f"host_pages counter {self._pcache.host_pages} != "
+                    f"walked marker count {tlive}"
+                )
 
     def generate(
         self,
@@ -1593,7 +1694,9 @@ class InferenceEngine:
     # -- host tier (inference.host_tier_bytes; README "Tiered prefix
     #    cache"): the two batched copy envelopes + the break-even gate ---
 
-    def _spill_pages(self, pages: list[int]) -> Optional[list[int]]:
+    def _spill_pages(
+        self, pages: list[int], *, tree: bool = True
+    ) -> Optional[list[int]]:
         """PrefixCache's spill callback: copy the victim pages' KV bytes
         (every cache array — int8 scale pools ride along) into host
         slots. ONE batched d2h serves the whole eviction sweep: one
@@ -1628,7 +1731,12 @@ class InferenceEngine:
             log.error("host-tier spill failed (%s); discarding instead", e)
             return None
         hp.store(hids, blocks, n)
-        self.prefix_stats.evicted_to_host += n
+        if tree:
+            # tree=False is the per-request paging caller (_page_out /
+            # _preempt_to_host): those slots never transit the radix
+            # tree, so they count as request_paged_out, not
+            # evicted_to_host.
+            self.prefix_stats.evicted_to_host += n
         return hids
 
     def _restore_pages(self, pages: list, node, host_idx: list[int]) -> None:
@@ -1887,6 +1995,276 @@ class InferenceEngine:
             req.freed_until = first
             if dead:
                 self.alloc.free(dead)
+            if req.host_pages:
+                # SWA rolled past a host-resident page: its KV will never
+                # be read again — drop the host slot instead of ever
+                # paying the h2d to restore a dead page.
+                rolled = [j for j in req.host_pages if j < first]
+                if rolled:
+                    self._host_pool.free(
+                        [req.host_pages.pop(j) for j in rolled]
+                    )
+
+    # -- per-request KV paging (inference.long_context; README "Long
+    #    context"): lazy chunk provisioning + host-tier demote/restore --
+
+    def _long_admission_need(self) -> int:
+        """Worst-instant pool demand of the LAZY chunked-prefill path
+        (the over-pool admission bound): pages spanned by
+        [cursor - W + 1, cursor + X - 1] for any page-aligned cursor —
+        the live window behind plus the larger of one chunk and the
+        decode provisioning window ahead — plus one page of span
+        misalignment and the +1 spare every admission carries. O(window),
+        independent of context length: that independence IS the
+        long-context admission story (PERF.md "Long context")."""
+        W = self.page_window
+        X = max(self.icfg.prefill_chunk_tokens, self._provision_window)
+        return (W + X - 2) // self.psz + 3
+
+    def _drop_host_pages(self, req: Request) -> None:
+        """Release every host slot a request holds (terminal paths and
+        recompute-from-scratch preemption — stale KV must not occupy the
+        tier)."""
+        if req.host_pages:
+            self._host_pool.free(list(req.host_pages.values()))
+            req.host_pages.clear()
+        req.host_cursor = 0
+
+    def _page_out(self, req: Request) -> None:
+        """Residency-cap demotion (inference.request_resident_pages):
+        after a long request's chunk, demote its OLDEST live private
+        pages beyond the cap to host slots — one batched d2h — freeing
+        device pages for co-tenants between this request's turns. The
+        pages come back through _page_in_request before the next chunk
+        that reads them. Spill failure (full tier / copy fault) degrades
+        to staying resident, never a failed step."""
+        cap = self.icfg.request_resident_pages
+        if not cap or not self._long or req.slot is None:
+            return
+        live = [
+            j for j in range(req.freed_until, len(req.pages))
+            if req.pages[j] is not None and j >= req.n_prefix
+        ]
+        excess = len(live) - cap
+        if excess <= 0:
+            return
+        victims = live[:excess]
+        pages = [req.pages[j] for j in victims]
+        hids = self._spill_pages(pages, tree=False)
+        if hids is None:
+            return
+        for j, h in zip(victims, hids):
+            req.host_pages[j] = h
+            req.pages[j] = None
+        self.page_table[req.slot, victims] = 0
+        self.alloc.free(pages)
+        self.prefix_stats.request_paged_out += len(pages)
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "page_out", rid=req.rid, pages=len(pages),
+                step=self.step_no, **self._trace_ctx(req),
+            )
+
+    def _page_in_request(self, req: Request) -> None:
+        """Restore a live request's host-resident pages into fresh pool
+        pages with ONE batched h2d, ahead of the chunk/decode dispatch
+        that reads them (every still-held slot is live: _roll_window
+        already dropped the rolled-dead ones).
+
+        Failure containment mirrors _restore_pages: pool exhaustion
+        propagates as MemoryError (the step fails and retries — the
+        request keeps its host refs); a fault inside the copy envelope —
+        injected (FaultSpec kind="restore") or real — unwinds the DEVICE
+        side completely (fresh pages freed) while the HOST side keeps
+        every slot, so the request stays resumable and a retry next step
+        pages in from scratch. No torn page on either tier."""
+        if not req.host_pages:
+            return
+        hp = self._host_pool
+        due = sorted(req.host_pages)
+        hids = [req.host_pages[j] for j in due]
+        n = len(hids)
+        fresh = self._alloc_pages(n)
+        try:
+            if self._injector is not None and (
+                self._injector.take("restore", self.step_no) is not None
+            ):
+                raise InjectedFault(
+                    f"injected restore fault (step {self.step_no})"
+                )
+            npad = 1 << (n - 1).bit_length()
+            padded = np.zeros(npad, np.int32)
+            padded[:n] = fresh
+            blocks = hp.load(hids)
+            if npad > n:
+                blocks = {
+                    k: np.concatenate(
+                        [v, np.zeros((npad - n,) + v.shape[1:], v.dtype)]
+                    )
+                    for k, v in blocks.items()
+                }
+            with self._device_span("page_in", "_pagein_span"), \
+                    self._tracer.annotation("orion/page_in"):
+                self.cache = self._scatter_pages(
+                    self.cache, jnp.asarray(padded),
+                    {k: jnp.asarray(v) for k, v in blocks.items()},
+                )
+                # orion: allow[host-sync] the ONE batched h2d per page-in — a torn copy must surface BEFORE any page maps
+                jax.block_until_ready(self.cache)
+        # orion: allow[fault-except] page-in envelope: free the fresh device pages, keep every host ref, typed DispatchFault
+        except Exception as e:
+            self.alloc.free(fresh)
+            self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path="page_in",
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise DispatchFault(
+                "page_in", f"{type(e).__name__}: {e}"
+            ) from e
+        for j, p in zip(due, fresh):
+            req.pages[j] = p
+            del req.host_pages[j]
+        hp.free(hids)
+        self.page_table[req.slot, due] = fresh
+        self.prefix_stats.request_paged_in += n
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "page_in", rid=req.rid, pages=n, step=self.step_no,
+                **self._trace_ctx(req),
+            )
+
+    def _provision_chunk_pages(self, req: Request, k: int) -> None:
+        """Lazy page materialization for the next chunk (the over-pool
+        admission path allocates NOTHING up front): extend the request's
+        page list to cover [cursor, cursor + k). Pool exhaustion raises
+        MemoryError out of _alloc_pages — the step fails with pages
+        owned, exactly the _grow_pages contract."""
+        n_need = -(-(req.prefill_done + k) // self.psz)
+        while len(req.pages) < n_need:
+            page = self._alloc_pages(1)[0]
+            self.page_table[req.slot, len(req.pages)] = page
+            req.pages.append(page)
+
+    def _preempt_to_host(self, req: Request, cursor: int) -> bool:
+        """Preempt-to-host (inference.long_context): spill the victim's
+        live private pages to host slots instead of discarding and
+        re-prefilling from scratch — for a long request the O(context)
+        chunked re-prefill is exactly the cost the tier exists to dodge.
+        Gated by the same measured break-even the tree restores use
+        (host_tier_min_tokens / the PERF.md arithmetic): below it,
+        recompute wins and the plain preempt path runs. Returns True
+        when the request left the slot host-resident."""
+        if not self._long or self._host_pool is None:
+            return False
+        if req.n_prefix:
+            # Shared prefix pages are tree-owned and immutable — the
+            # radix tier already covers them; mixed ownership is not
+            # worth the accounting.
+            return False
+        live = [
+            j for j in range(req.freed_until, len(req.pages))
+            if req.pages[j] is not None
+        ]
+        span = (len(live) + len(req.host_pages)) * self.psz
+        if span < self._host_min_tokens:
+            return False
+        hids = None
+        if live:
+            hids = self._spill_pages(
+                [req.pages[j] for j in live], tree=False
+            )
+            if hids is None:
+                return False   # tier full / copy fault: plain preempt
+        slot = req.slot
+        if hids is not None:
+            req.host_pages.update(zip(live, hids))
+            self.prefix_stats.request_paged_out += len(live)
+        req.host_cursor = cursor
+        req.host_last_token = int(self.last_token[slot])
+        self.alloc.free([req.pages[j] for j in live])
+        req.pages = []
+        if req.prefix_node is not None:   # unreachable (n_prefix == 0)
+            self._pcache.unlock(req.prefix_node)
+            req.prefix_node = None
+        req.slot = None
+        self.slots[slot] = None
+        self.page_table[slot] = 0
+        self.seq_lens[slot] = 0
+        self.last_token[slot] = 0
+        if self._spec is not None:
+            self._spec.drop(req.rid)
+        self.waiting.appendleft(req)
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "preempt_to_host", rid=req.rid, pages=len(live),
+                cursor=cursor, step=self.step_no, **self._trace_ctx(req),
+            )
+        return True
+
+    def _readmit_host(
+        self, req: Request, slot: int, reserved: int
+    ) -> Optional[int]:
+        """Re-admit a host-resident request (preempt-to-host's other
+        half): allocate fresh device pages for every spilled logical
+        page, batched-restore them, and resume at the spill-time cursor
+        — no re-prefill at all. Returns the claimed-but-unallocated page
+        count (the caller's ``reserved`` delta), or None (head-of-line
+        block) when the pool lacks the restore + first-window headroom;
+        raises DispatchFault out of the copy envelope with the admission
+        fully unwound (the request re-queues at the head, still
+        host-resident, and retries next step)."""
+        n = len(req.host_pages)
+        last = min(
+            req.host_cursor + self._provision_window - 1,
+            self.icfg.max_seq_len - 1,
+        )
+        first_window = min(last // self.psz + 1, self.pages_per_seq)
+        n_logical = max(
+            max(req.host_pages) + 1 if req.host_pages else 0,
+            -(-req.host_cursor // self.psz),
+        )
+        need = max(n + 1, n + first_window - n_logical + 1)
+        if self._available() - reserved < need:
+            return None
+        req.slot = slot
+        req.admit_seq = next(self._admit_seq)
+        req.pages = [None] * n_logical
+        self.slots[slot] = req
+        self.page_table[slot] = 0
+        try:
+            self._page_in_request(req)
+        except (MemoryError, DispatchFault):
+            # Unwind the claim completely; host refs survive inside the
+            # envelope, so the request re-queues resumable either way.
+            req.pages = []
+            req.slot = None
+            self.slots[slot] = None
+            self.waiting.appendleft(req)
+            raise
+        icfg = self.icfg
+        self.slot_temp[slot] = (
+            icfg.temperature if req.temperature is None
+            else req.temperature
+        )
+        self.slot_top_k[slot] = (
+            icfg.top_k if req.top_k is None else req.top_k
+        )
+        self.slot_top_p[slot] = (
+            icfg.top_p if req.top_p is None else req.top_p
+        )
+        self.seq_lens[slot] = req.host_cursor
+        self.last_token[slot] = req.host_last_token
+        req.prefill_done = req.host_cursor
+        req.prefill_pending = req.host_cursor < len(req.context)
+        req.host_cursor = 0
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "admit", rid=req.rid, slot=slot, step=self.step_no,
+                priority=req.priority, host_restored=n,
+                **self._trace_ctx(req),
+            )
+        return need - n
 
     def _admit(self) -> None:
         # Pass 1 (host): claim slots + pages for every admissible request,
@@ -1917,6 +2295,19 @@ class InferenceEngine:
             )
             if slot is None:
                 break
+            if req.host_pages:
+                # Host-resident re-admission (preempt-to-host's other
+                # half): restore the spilled pages and resume at the
+                # spill-time cursor — no re-prefill. A DispatchFault out
+                # of the copy envelope has already unwound the claim and
+                # re-queued the request; let it fail the step.
+                del self.waiting[idx]
+                delta = self._readmit_host(req, slot, reserved)
+                if delta is None:
+                    self.waiting.insert(idx, req)
+                    break   # head-of-line blocking, as below
+                reserved += delta
+                continue
             context = req.context
             # Prefix cache: map the longest cached prefix (shared,
             # refcount++) and prefill only the uncached tail. The matched
@@ -1943,6 +2334,15 @@ class InferenceEngine:
                         n_match, m_pages, m_node = 0, [], None
                     else:
                         m_pages = m_pages[:n_match]
+            if n_match and self._lazy and self._admission_need_warm(
+                len(context), n_match, full
+            )[3] > self.icfg.num_pages - 1:
+                # Over-pool long request with a prefix match: the warm
+                # path's eager tail allocation can NEVER fit — drop the
+                # match and take the lazy cold branch below.
+                self._pcache.unlock(m_node)
+                n_match, m_pages, m_node = 0, [], None
+                full = False
             if n_match:
                 n_pages, first_live, n_alloc, need = (
                     self._admission_need_warm(len(context), n_match, full)
@@ -1959,6 +2359,17 @@ class InferenceEngine:
                 n_pages, first_live, need = self._admission_need(len(context))
                 n_alloc = n_pages - first_live
                 s_pad = self._bucket_len(len(context))
+                if self._lazy and need > self.icfg.num_pages - 1:
+                    # Over-pool long-context admission (inference.
+                    # long_context): the eager footprint can never fit —
+                    # admit on the O(window) lazy working set instead.
+                    # NO pages allocate here: chunks materialize their
+                    # own (_provision_chunk_pages) and _roll_window
+                    # frees behind the window, so the pool never holds
+                    # the O(context) footprint at once.
+                    first_live = 0
+                    n_alloc = 0
+                    need = self._long_admission_need()
             if self._available() - reserved < need:
                 if m_node is not None:
                     self._pcache.unlock(m_node)
@@ -2049,7 +2460,9 @@ class InferenceEngine:
             self.slot_top_p[slot] = (
                 icfg.top_p if req.top_p is None else req.top_p
             )
-            self.page_table[slot, :n_pages] = [
+            # len(req.pages) == n_pages on every eager branch; the lazy
+            # branch admitted with NO pages (they materialize per chunk).
+            self.page_table[slot, :len(req.pages)] = [
                 0 if p is None else p for p in req.pages
             ]
             if full:
@@ -2206,6 +2619,10 @@ class InferenceEngine:
         self.alloc.free([p for p in req.pages if p is not None])
         req.pages = []
         req.n_prefix = 0
+        # Host-resident pages are stale the moment the device side drops
+        # (terminal exit, or a recompute-from-scratch preemption — the
+        # preempt-to-host path never reaches here): release the slots.
+        self._drop_host_pages(req)
         if self._spec is not None:
             # Adaptive draft-length state dies with the slot; a preempted
             # request restarts adaptation cold on re-admission.
@@ -2232,10 +2649,17 @@ class InferenceEngine:
         (cheaply, when the prefix cache kept its pages)."""
         log.info("preempting request %d (pool pressure)", req.rid)
         self.preemptions += 1
+        cursor = int(self.seq_lens[req.slot])
+        # Preempt-to-host (inference.long_context): for a long request
+        # past the restore break-even, spill live pages to host slots and
+        # resume at the cursor on re-admission — replacing the O(context)
+        # recompute-from-scratch below.
+        if self._preempt_to_host(req, cursor):
+            return
         # Mid-prefill preemption: seq_lens is the chunk cursor, so exactly
         # the completed chunks' full pages donate to the prefix cache and
         # re-admission resumes from whatever the cache kept.
-        self._teardown_slot(req, int(self.seq_lens[req.slot]))
+        self._teardown_slot(req, cursor)
         req.freed_until = 0
         req.prefill_pending = False
         req.prefill_done = 0
@@ -2354,6 +2778,15 @@ class InferenceEngine:
         drafts: dict[int, list[int]] = {}
         n_drafted = 0
         for r in cands:
+            if r.host_pages:
+                # Long-context hold: part of this slot's KV is host-
+                # resident (a page-in fault left residue), so a
+                # multi-token verify would read pages the page-in pass
+                # has not restored yet. Hold to a plain 1-token row this
+                # step; the restore runs before dispatch and the slot
+                # drafts again next step.
+                drafts[r.slot] = None if self._tree else []
+                continue
             pos = int(self.seq_lens[r.slot])
             limit = min(
                 self.icfg.max_seq_len - 1 - pos,
@@ -2875,6 +3308,13 @@ class InferenceEngine:
     def _decode_all(self) -> bool:
         self._roll_window()
         live = [r for r in self.slots if r is not None and not r.done]
+        if self._long:
+            # Host-resident residue on a decode slot (a page-in fault
+            # retrying, per the keep-host-refs envelope): restore before
+            # any dispatch reads the pages.
+            for r in live:
+                if r.host_pages:
+                    self._page_in_request(r)
         if self.constrained and any(
             r.constraint is not None for r in live
         ):
@@ -2988,6 +3428,14 @@ class InferenceEngine:
             drafts = self._propose_constrained_drafts(dec_cands)
         elif self._spec is not None and not self._spec_disabled:
             drafts = self._propose_drafts(dec_cands)
+        if self._long:
+            # Decode-phase host residue (a failed page-in retrying):
+            # restore AFTER drafting — _propose_drafts held non-resident
+            # slots to a 1-token row, so this pass never races a
+            # multi-token verify against pages it is still copying.
+            for r in dec_cands:
+                if r.host_pages:
+                    self._page_in_request(r)
         self._grow_pages(
             self.icfg.speculate_tokens + 1 if drafts is not None else None
         )
@@ -3019,6 +3467,30 @@ class InferenceEngine:
                     break
             budget -= k
             chunks.append((r, k))
+        if self._long:
+            # Long-context page passes, restore-then-provision per chunk
+            # getter: host-resident pages this chunk's window reads come
+            # back in ONE batched h2d (inference.request_resident_pages
+            # demoted them after the previous chunk), then the lazy
+            # admission path materializes the chunk's own pages (over-pool
+            # admission allocated NONE up front). Either raise
+            # (DispatchFault / MemoryError) fails the step with both
+            # tiers consistent.
+            for r, k in chunks:
+                if r.host_pages:
+                    self._page_in_request(r)
+                try:
+                    self._provision_chunk_pages(r, k)
+                except MemoryError:
+                    # Chunk provisioning has no grow-time preemption
+                    # valve (_grow_pages only serves decode spans), so
+                    # pool exhaustion HERE would fail the step forever.
+                    # Park THIS request instead — preempt-to-host past
+                    # the break-even, plain preempt below it — and let
+                    # co-tenants drain the pressure.
+                    self.robust.pool_faults += 1
+                    self._preempt(r)
+            chunks = [(r, k) for r, k in chunks if r.slot is not None]
         nb = 1 << max(len(chunks) - 1, 0).bit_length()
         n_pages = S // psz
         tokens = np.zeros((nb, S), np.int32)
@@ -3050,7 +3522,8 @@ class InferenceEngine:
         if pending:
             d_pt = self.page_table.copy()
             for r in pending:
-                d_pt[r.slot] = 0
+                if r.slot is not None:   # provisioning may have preempted
+                    d_pt[r.slot] = 0
         dec = [
             r for r in self.slots
             if r is not None and not r.done and not r.prefill_pending
@@ -3208,6 +3681,17 @@ class InferenceEngine:
                 self.last_token[r.slot] = tok
                 r.generated.append(tok)
                 self._maybe_finish(r, tok)
+        if self._long and self.icfg.request_resident_pages:
+            # Residency demotion between a long request's turns: roll the
+            # window first (never demote a page the window already passed
+            # — _page_out picks the OLDEST live pages, exactly the
+            # about-to-roll ones), then spill still-mid-prefill chunk
+            # getters past the cap. Demotion failure degrades to staying
+            # resident, so this pass cannot fail the step.
+            self._roll_window()
+            for r, _k in chunks:
+                if r.prefill_pending and not r.done:
+                    self._page_out(r)
 
         # Decode bookkeeping. Speculative: accepted prefix + bonus per
         # slot, then rollback (same walk as the pure verify step).

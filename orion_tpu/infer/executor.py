@@ -80,6 +80,12 @@ class DispatchExecutor:
                 cfg=mcfg, max_seq_len=icfg.max_seq_len, mesh=mesh,
                 nan_guard=self.eng._guard,
             )
+        if stem in ("prefill", "mixed", "mixed_verify"):
+            # Blockwise paged-flash prefill (inference.paged_prefill):
+            # resolved against THIS build's kernels — the XLA fallback
+            # build (kernels="xla") ignores it inside _prefill_ctx, so
+            # the reference body stays the degradation-ladder rung.
+            kw["paged_prefill"] = icfg.paged_prefill
         if is_default:
             kw.update(
                 temperature=icfg.temperature,

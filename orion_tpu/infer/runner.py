@@ -112,17 +112,29 @@ def _prefill_ctx(
     prefix_lens: Optional[jax.Array],
     prefix_pages: Optional[jax.Array],
     cfg: ModelConfig,
+    paged_prefill: bool = False,
 ) -> dict:
     """Batch-level tensors the per-layer prefill body consumes (positions,
     segment ids, page arithmetic). Shared by whole-prompt prefill, the
     prefix-cache tail prefill, and the chunked-prefill rows of a mixed
     step — a prefill CHUNK is exactly a mid-sequence tail prefill that
-    resumes at a page-aligned ``prefix_lens`` over already-written pages."""
+    resumes at a page-aligned ``prefix_lens`` over already-written pages.
+
+    ``paged_prefill`` (inference.paged_prefill, pallas path only) routes
+    the P_pre > 0 layers through the blockwise paged-flash prefill kernel
+    instead of the dense prefix gather + flash attention + scatter: the
+    chunk's queries walk the paged history directly and the chunk's own
+    pages are written in-kernel (aliased), so per-chunk HBM traffic is
+    O(real context) instead of O(padded gather copy)."""
+    from orion_tpu.ops._dispatch import resolve_impl
+
     Nb, S_pad = tokens.shape
     psz = cache["k"].shape[2]
     NP = cache["k"].shape[0] // cfg.n_layers
     quant = "k_scale" in cache
     P_pre = 0 if prefix_pages is None else prefix_pages.shape[1]
+    use_pallas, interpret = resolve_impl(cfg.kernels)
+    paged = bool(paged_prefill and P_pre and use_pallas and S_pad % psz == 0)
     kv_pos = kv_seg = None
     if P_pre:
         positions = prefix_lens[:, None] + jnp.arange(S_pad, dtype=jnp.int32)
@@ -150,11 +162,18 @@ def _prefill_ctx(
         # mixed-length admission burst pays per-row actual-length compute in
         # one dispatch instead of bucket-padded compute per bucket.
         seg = (positions < lengths[:, None]).astype(jnp.int32)
+    walk = None
+    if paged:
+        # Combined page walk for the paged-flash kernel: the row's prefix
+        # pages, then the chunk's own pages (walk step P_pre + cb OWNS
+        # chunk page cb — the kernel's fused write targets it).
+        walk = jnp.concatenate([prefix_pages, pages], axis=1)
     return dict(
         Nb=Nb, S_pad=S_pad, psz=psz, NP=NP, n_pages=S_pad // psz,
         quant=quant, P_pre=P_pre, positions=positions, seg=seg,
         kv_pos=kv_pos, kv_seg=kv_seg, pages=pages,
-        prefix_pages=prefix_pages,
+        prefix_pages=prefix_pages, prefix_lens=prefix_lens,
+        lengths=lengths, paged=paged, interpret=interpret, walk=walk,
     )
 
 
@@ -176,6 +195,39 @@ def _prefill_layer(
     positions, seg = ctx["positions"], ctx["seg"]
     h = _norm(x, bp["attn_norm"], cfg)
     q, k, v = qkv_proj(h, bp["attn"], cfg, positions)
+    if P_pre and ctx["paged"]:
+        # Paged-flash prefill: the chunk's queries walk the paged history
+        # in-kernel (no dense prefix gather) and the chunk's own pages
+        # are written fused (no external scatter) — one kernel replaces
+        # the whole gather/attend/scatter body below, O(real context)
+        # HBM traffic per chunk.
+        from orion_tpu.ops.pallas.paged_flash_prefill import (
+            paged_flash_prefill,
+        )
+
+        res = paged_flash_prefill(
+            q, cc["k"], cc["v"], ctx["walk"], ctx["prefix_lens"],
+            ctx["lengths"], k, v,
+            n_prefix_pages=P_pre, layer_base=l * NP,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.layer_window(j), interpret=ctx["interpret"],
+            k_scale=cc.get("k_scale"), v_scale=cc.get("v_scale"),
+            mesh=mesh,
+        )
+        cc = dict(cc)
+        if quant:
+            out, cc["k"], cc["v"], cc["k_scale"], cc["v_scale"] = res
+        else:
+            out, cc["k"], cc["v"] = res
+        a = out_proj(out, bp["attn"], cfg)
+        if cfg.post_norms:
+            a = _norm(a, bp["post_attn_norm"], cfg)
+        x = x + a
+        h2 = _norm(x, bp["mlp_norm"], cfg)
+        y, _ = mlp_or_moe(h2, bp, cfg)
+        if cfg.post_norms:
+            y = _norm(y, bp["post_mlp_norm"], cfg)
+        return x + y, cc
     if P_pre:
         # Gather this layer's cached prefix K/V pages from the pool
         # and attend tail queries over prefix + tail. [Nb, P_pre] page
@@ -275,6 +327,7 @@ def prefill_step(
     *,
     cfg: ModelConfig,
     mesh: Optional[jax.sharding.Mesh] = None,
+    paged_prefill: bool = False,
 ) -> tuple[jax.Array, Cache]:
     """Prefill a batch of same-bucket prompts in ONE dispatch.
 
@@ -304,7 +357,8 @@ def prefill_step(
     is never read.
     """
     ctx = _prefill_ctx(
-        cache, tokens, lengths, pages, prefix_lens, prefix_pages, cfg
+        cache, tokens, lengths, pages, prefix_lens, prefix_pages, cfg,
+        paged_prefill=paged_prefill,
     )
 
     def body(carry, bp, l, j):
@@ -928,6 +982,7 @@ def mixed_step(
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
     nan_guard: bool = False,
+    paged_prefill: bool = False,
 ) -> tuple[jax.Array, ...]:
     """One UNIFIED mixed prefill+decode step (inference.chunked_prefill):
     a single-token decode for every live slot fused with up to the chunk
@@ -962,7 +1017,7 @@ def mixed_step(
     wp = jnp.minimum(seq_lens, max_seq_len - 1)
     pctx = _prefill_ctx(
         cache, p_tokens, p_lengths, p_pages, p_prefix_lens, p_prefix_pages,
-        cfg,
+        cfg, paged_prefill=paged_prefill,
     )
     dctx = _decode_ctx(cache, wp, page_table, cfg)
 
@@ -1012,6 +1067,7 @@ def mixed_verify_step(
     max_seq_len: int,
     mesh: Optional[jax.sharding.Mesh] = None,
     nan_guard: bool = False,
+    paged_prefill: bool = False,
     depths: Optional[jax.Array] = None,     # [B, W] tree depth per column
     parents: Optional[jax.Array] = None,    # [B, W] parent column per col
     tree_mask: Optional[jax.Array] = None,  # [B, W] packed ancestor words
@@ -1039,7 +1095,7 @@ def mixed_verify_step(
     W = tokens.shape[1]
     pctx = _prefill_ctx(
         cache, p_tokens, p_lengths, p_pages, p_prefix_lens, p_prefix_pages,
-        cfg,
+        cfg, paged_prefill=paged_prefill,
     )
     vctx = _verify_ctx(
         cache, seq_lens, lens, page_table, active, W, max_seq_len, cfg,

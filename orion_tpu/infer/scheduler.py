@@ -43,9 +43,11 @@ class Request:
     # are reaped at step boundaries with a typed "expired" outcome.
     deadline: Optional[float] = None
     # Typed terminal outcome: "" while live, then exactly one of
-    # "completed" | "expired" | "cancelled" | "shed" | "error:<kind>".
-    # Every submitted request surfaces from step() with an outcome — no
-    # silent drops.
+    # "completed" | "expired" | "cancelled" | "shed" | "shed:<kind>" |
+    # "error:<kind>". Every submitted request surfaces from step() with
+    # an outcome — no silent drops. "shed:<kind>" carries a policy
+    # reason (today: "shed:context_too_long" — the long-context
+    # feasibility check; plain "shed" stays overload/drain).
     outcome: str = ""
     # Trace context (ISSUE 14): the fleet-level correlation id stamped by
     # the router at submit and carried through every engine attempt —
@@ -74,6 +76,20 @@ class Request:
     # the slot rides mixed steps as a prompt-chunk row, never a decode row.
     prefill_done: int = 0
     prefill_pending: bool = False
+    # Long-context host paging (inference.long_context): logical page
+    # index -> HostPagePool slot holding that page's KV bytes, one
+    # ENGINE-owned ref per slot (the prefix tree never sees these).
+    # Populated by residency demotion (inference.request_resident_pages)
+    # and preempt-to-host; drained by the engine's page-in pass before
+    # the chunk/decode dispatch that reads them, or dropped when the SWA
+    # window rolls past a host-resident page / the request terminates.
+    host_pages: dict[int, int] = field(default_factory=dict)
+    # Spill-time snapshot for preempt-to-host: KV is valid in
+    # [0, host_cursor) across device+host pages, and host_last_token is
+    # the in-flight token — re-admission restores and resumes instead of
+    # re-prefilling the whole context.
+    host_cursor: int = 0
+    host_last_token: int = 0
     # Grammar constraint (orion_tpu.constrain.ConstraintState): the
     # request's walk through its token DFA. Pure host state — survives
     # preemption (re-prefill replays prompt + generated; the state

@@ -102,6 +102,13 @@ class PrefixCacheStats:
     host_hits: int = 0
     host_restored_pages: int = 0
     host_recompute_skips: int = 0
+    # Per-request paging (inference.long_context): pages a live request
+    # demoted to host slots (residency cap / preempt-to-host) and pages
+    # restored ahead of the dispatch that reads them. Distinct from the
+    # tree's evicted_to_host/host_restored_pages: these carry
+    # engine-owned refs and never transit the radix tree.
+    request_paged_out: int = 0
+    request_paged_in: int = 0
 
     @property
     def hit_rate(self) -> float:
@@ -122,6 +129,8 @@ class PrefixCacheStats:
             "host_hits": self.host_hits,
             "host_restored_pages": self.host_restored_pages,
             "host_recompute_skips": self.host_recompute_skips,
+            "request_paged_out": self.request_paged_out,
+            "request_paged_in": self.request_paged_in,
         }
 
 
@@ -277,9 +286,13 @@ class RobustnessStats:
     path failed (the engine continues; state untouched),
     ``stalled_steps`` steps the watchdog flagged as stalled, and
     ``pool_faults`` page-allocation failures absorbed at admit/grow.
+    ``shed_context`` counts the "shed:context_too_long" subset of
+    ``shed`` — requests the long-context feasibility check refused with
+    a typed outcome instead of a raw raise (inference.long_context).
     """
 
     shed: int = 0
+    shed_context: int = 0
     expired: int = 0
     cancelled: int = 0
     quarantined: int = 0
@@ -294,6 +307,7 @@ class RobustnessStats:
         """Flatten into the engine's reset_timing dict."""
         return {
             "shed_requests": self.shed,
+            "shed_context_requests": self.shed_context,
             "expired_requests": self.expired,
             "cancelled_requests": self.cancelled,
             "quarantined_requests": self.quarantined,

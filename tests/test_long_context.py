@@ -1,0 +1,481 @@
+"""Long-context serving (ISSUE 19): blockwise paged-flash prefill +
+per-request KV paging to the host tier.
+
+Load-bearing properties, in the order the PR's story tells them:
+
+- KERNEL PARITY: paged_flash_prefill (ops/pallas/paged_flash_prefill)
+  matches a dense NumPy reference over the full feature grid — prefix
+  walk, ragged lengths, GQA, sliding window, logit softcap, int8 KV with
+  per-token scales — and its fused whole-page pool writes land exactly
+  (new pages written, prefix pages and scale tails untouched).
+- OVER-POOL ADMIT-AND-COMPLETE: with inference.long_context on
+  (SWA + chunked prefill), a greedy request whose eager KV footprint
+  exceeds the device pool is admitted via lazy page provisioning and
+  completes BYTE-IDENTICAL to the same request on an enlarged pool —
+  f32 and int8 (scale pools ride the same spill/restore).
+- RESIDENCY DEMOTION: inference.request_resident_pages caps a long
+  request's between-turn device residency; demoted pages round-trip the
+  host tier (request_paged_out == request_paged_in) with no token drift.
+- TYPED SHED: an infeasible long request (full attention, or the lazy
+  working set itself over-pool) surfaces "shed:context_too_long" and the
+  RobustnessStats.shed_context counter — never a raw raise.
+- PREEMPT-TO-HOST: pool-pressure preemption of a long request past the
+  restore break-even spills live pages to host slots and resumes at the
+  spill-time cursor (no O(context) recompute); below the break-even the
+  plain recompute path runs. Both byte-identical.
+- FAULT CONTAINMENT: a restore fault mid-page-in (FaultSpec
+  kind="restore") unwinds the device side completely, keeps every host
+  ref, fails the step, and the retry completes byte-identical — both
+  pools balanced throughout (assert_page_accounting).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import get_config
+from orion_tpu.infer import InferenceEngine
+from orion_tpu.models import init_params
+from orion_tpu.ops.pallas.common import quantize_kv
+from orion_tpu.ops.pallas.paged_flash_prefill import paged_flash_prefill
+from orion_tpu.runtime.fault import FaultInjector, FaultSpec
+
+slow = pytest.mark.slow
+
+# -- kernel parity -----------------------------------------------------------
+
+
+def _parity_case(quant, window, softcap, B=2, psz=16, K=2, G=2, H=64,
+                 P_pre=3, NC=2):
+    """paged_flash_prefill vs a dense NumPy reference: outputs for every
+    real (row, position, head), fused pool writes for every chunk page,
+    prefix pages and int8 scale tails untouched."""
+    rng = np.random.RandomState(0)
+    N, S = K * G, NC * psz
+    NP = 64
+    rows = NP
+    if quant:
+        k_pool = jnp.asarray(
+            rng.randint(-127, 127, (rows, K, psz, H)), jnp.int8
+        )
+        v_pool = jnp.asarray(
+            rng.randint(-127, 127, (rows, K, psz, H)), jnp.int8
+        )
+        k_scale = jnp.asarray(
+            rng.rand(rows, K, 128).astype(np.float32) * 0.05 + 0.01
+        )
+        v_scale = jnp.asarray(
+            rng.rand(rows, K, 128).astype(np.float32) * 0.05 + 0.01
+        )
+    else:
+        k_pool = jnp.asarray(rng.randn(rows, K, psz, H).astype(np.float32))
+        v_pool = jnp.asarray(rng.randn(rows, K, psz, H).astype(np.float32))
+        k_scale = v_scale = None
+    q = jnp.asarray(rng.randn(B, S, N, H).astype(np.float32))
+    k_new = jnp.asarray(rng.randn(B, S, K, H).astype(np.float32))
+    v_new = jnp.asarray(rng.randn(B, S, K, H).astype(np.float32))
+    perm = rng.permutation(NP - 1)[: B * (P_pre + NC)] + 1
+    walk = jnp.asarray(perm.reshape(B, P_pre + NC).astype(np.int32))
+    # Row 0: full prefix, full chunk; row 1: one prefix page, ragged len.
+    start = jnp.asarray([P_pre * psz, 1 * psz], jnp.int32)
+    lens = jnp.asarray([S, 7], jnp.int32)
+
+    res = paged_flash_prefill(
+        q, k_pool, v_pool, walk, start, lens, k_new, v_new,
+        n_prefix_pages=P_pre, layer_base=0, logit_softcap=softcap,
+        window=window, interpret=True, k_scale=k_scale, v_scale=v_scale,
+    )
+    if quant:
+        out, kp2, vp2, ks2, vs2 = res
+    else:
+        out, kp2, vp2 = res
+
+    for b in range(B):
+        st, ln = int(start[b]), int(lens[b])
+        pre_rows = np.asarray(walk[b, :P_pre])
+        kp = np.asarray(k_pool)[pre_rows].transpose(0, 2, 1, 3).reshape(
+            P_pre * psz, K, H
+        ).astype(np.float32)
+        vp = np.asarray(v_pool)[pre_rows].transpose(0, 2, 1, 3).reshape(
+            P_pre * psz, K, H
+        ).astype(np.float32)
+        if quant:
+            ksc = np.asarray(k_scale)[pre_rows][..., :psz].transpose(
+                0, 2, 1
+            ).reshape(P_pre * psz, K)
+            vsc = np.asarray(v_scale)[pre_rows][..., :psz].transpose(
+                0, 2, 1
+            ).reshape(P_pre * psz, K)
+            kp = kp * ksc[..., None]
+            vp = vp * vsc[..., None]
+        kk = np.concatenate([kp, np.asarray(k_new)[b]], 0)
+        vv = np.concatenate([vp, np.asarray(v_new)[b]], 0)
+        kv_pos = np.concatenate(
+            [np.arange(P_pre * psz), st + np.arange(S)]
+        )
+        kv_seg = np.concatenate(
+            [np.arange(P_pre * psz) < st, np.arange(S) < ln]
+        )
+        for s_ in range(ln):
+            qp = st + s_
+            mask = kv_seg & (kv_pos <= qp)
+            if window is not None:
+                mask = mask & (kv_pos >= qp - window + 1)
+            for n in range(N):
+                kh, vh = kk[:, n // G], vv[:, n // G]
+                z = (np.asarray(q)[b, s_, n] @ kh.T) * (H ** -0.5)
+                if softcap is not None:
+                    z = softcap * np.tanh(z / softcap)
+                z = np.where(mask, z, -1e30)
+                z = z - z.max()
+                p = np.exp(z) * mask
+                o_ref = (p / p.sum()) @ vh
+                np.testing.assert_allclose(
+                    np.asarray(out)[b, s_, n], o_ref,
+                    rtol=3e-5, atol=3e-5,
+                    err_msg=f"output b={b} s={s_} n={n}",
+                )
+    # Fused whole-page pool writes: the chunk pages of every row land
+    # exactly (idempotent page-granular write), quantized through the
+    # SAME quantize_kv the decode write path uses.
+    kp2n, vp2n = np.asarray(kp2), np.asarray(vp2)
+    for b in range(B):
+        for cb in range(NC):
+            row = int(walk[b, P_pre + cb])
+            page_k = np.asarray(k_new)[b, cb * psz:(cb + 1) * psz].transpose(
+                1, 0, 2
+            )
+            page_v = np.asarray(v_new)[b, cb * psz:(cb + 1) * psz].transpose(
+                1, 0, 2
+            )
+            if quant:
+                qk, sk = quantize_kv(jnp.asarray(page_k))
+                qv_, sv = quantize_kv(jnp.asarray(page_v))
+                assert np.array_equal(np.asarray(qk), kp2n[row])
+                assert np.array_equal(np.asarray(qv_), vp2n[row])
+                assert np.array_equal(
+                    np.asarray(sk), np.asarray(ks2)[row][:, :psz]
+                )
+                assert np.array_equal(
+                    np.asarray(sv), np.asarray(vs2)[row][:, :psz]
+                )
+                # Scale lanes past the page are other pages' state.
+                assert np.array_equal(
+                    np.asarray(ks2)[row][:, psz:],
+                    np.asarray(k_scale)[row][:, psz:],
+                )
+            else:
+                assert np.array_equal(page_k.astype(kp2n.dtype), kp2n[row])
+                assert np.array_equal(page_v.astype(vp2n.dtype), vp2n[row])
+    pre_all = np.asarray(walk[:, :P_pre]).ravel()
+    assert np.array_equal(kp2n[pre_all], np.asarray(k_pool)[pre_all]), (
+        "prefix pages clobbered"
+    )
+
+
+def test_kernel_parity_f32():
+    _parity_case(quant=False, window=None, softcap=None)
+
+
+def test_kernel_parity_int8_window_softcap():
+    _parity_case(quant=True, window=24, softcap=20.0)
+
+
+@slow
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("window,softcap", [(24, None), (None, 20.0)])
+def test_kernel_parity_grid(quant, window, softcap):
+    _parity_case(quant=quant, window=window, softcap=softcap)
+
+
+# -- serving: per-request KV paging ------------------------------------------
+
+BASE = [
+    "inference.max_seq_len=256",
+    "inference.page_size=16",
+    "inference.max_batch_size=2",
+    "inference.prefill_chunk=16",
+    "inference.max_new_tokens=8",
+    "inference.chunked_prefill=true",
+    "inference.prefill_chunk_tokens=32",
+    "model.sliding_window=32",
+]
+LONG = [
+    "inference.long_context=true",
+    "inference.host_tier_bytes=262144",
+    "inference.host_tier_min_tokens=0",
+]
+# 200 tokens = 12.5 pages: eager need (13 + decode headroom) can never
+# fit the 12-page pool below, AND the final chunk straddles a page
+# boundary (200 % 32 = 8 left after six 32-token chunks), so every run
+# exercises the non-page-multiple tail.
+PROMPT = [(i * 11) % 250 + 1 for i in range(200)]
+
+_REF_CACHE: dict = {}
+
+
+def _setup(overrides=(), long=True):
+    ov = list(BASE) + (list(LONG) if long else []) + list(overrides)
+    cfg = get_config("tiny-llama", ov)
+    params = _REF_CACHE.setdefault(
+        "params", init_params(cfg.model, jax.random.key(0))
+    )
+    return cfg, params
+
+
+def _reference():
+    """Tokens from an enlarged pool WITHOUT long_context — the identity
+    target for every over-pool run (computed once per module)."""
+    if "ref" not in _REF_CACHE:
+        cfg, params = _setup(["inference.num_pages=64"], long=False)
+        _REF_CACHE["ref"] = InferenceEngine(cfg, params).generate(
+            [PROMPT], 8
+        )
+    return _REF_CACHE["ref"]
+
+
+def test_validation():
+    """long_context requires chunked prefill AND a host tier."""
+    cfg, params = _setup()
+    assert cfg.inference.long_context is True
+    bad = get_config("tiny-llama", [
+        o for o in BASE if "chunked" not in o and "chunk_tokens" not in o
+    ] + LONG)
+    with pytest.raises(ValueError, match="chunked"):
+        InferenceEngine(bad, params)
+    bad2 = get_config("tiny-llama", BASE + ["inference.long_context=true"])
+    with pytest.raises(ValueError, match="host_tier"):
+        InferenceEngine(bad2, params)
+
+
+def test_overpool_admit_and_complete_f32():
+    """The acceptance pin: a greedy request whose KV exceeds the pool is
+    ADMITTED (lazy provisioning), completes, and its tokens are
+    byte-identical to the same request on an enlarged pool."""
+    cfg, params = _setup(["inference.num_pages=12"])
+    eng = InferenceEngine(cfg, params)
+    out = eng.generate([PROMPT], 8)
+    assert out == _reference()
+    eng.assert_page_accounting()
+    t = eng.reset_timing()
+    assert t["shed_context_requests"] == 0
+    # Peak device footprint stayed O(window), not O(context): the pool
+    # (11 usable pages) never held the 13+ page eager footprint.
+    assert eng.alloc.free_pages == cfg.inference.num_pages - 1
+
+
+def test_residency_demotion_round_trip():
+    """request_resident_pages=1 forces between-turn demotion; every
+    demoted page pages back in before the chunk that reads it, tokens
+    stay byte-identical, and the page_in timing bucket surfaces."""
+    cfg, params = _setup([
+        "inference.num_pages=12", "inference.request_resident_pages=1",
+    ])
+    eng = InferenceEngine(cfg, params)
+    out = eng.generate([PROMPT], 8)
+    assert out == _reference()
+    eng.assert_page_accounting()
+    t = eng.reset_timing()
+    assert t["request_paged_out"] > 0
+    assert t["request_paged_out"] == t["request_paged_in"]
+    assert t["page_in_s"] > 0.0
+    hp = eng._host_pool
+    assert hp.free_slots == hp.capacity   # nothing left resident
+
+
+def test_overpool_int8():
+    """Same admit-and-complete identity with int8 KV: quantized pages
+    AND their scale lanes round-trip the host tier bit-exact."""
+    cfg, params = _setup([
+        "inference.num_pages=12", "inference.request_resident_pages=1",
+        "inference.kv_quant=int8",
+    ])
+    ref_cfg, _ = _setup(
+        ["inference.num_pages=64", "inference.kv_quant=int8"], long=False
+    )
+    ref = InferenceEngine(ref_cfg, params).generate([PROMPT], 8)
+    eng = InferenceEngine(cfg, params)
+    assert eng.generate([PROMPT], 8) == ref
+    eng.assert_page_accounting()
+    t = eng.reset_timing()
+    assert t["request_paged_out"] > 0
+
+
+def test_shed_context_too_long():
+    """Full attention cannot run over-pool at dispatch granularity:
+    typed "shed:context_too_long" outcome + shed_context counter, never
+    a raw raise; the request still surfaces from step() and feasible
+    work keeps flowing on the same engine."""
+    _, params = _setup()
+    cfg = get_config("tiny-llama", [
+        o for o in BASE if "sliding" not in o
+    ] + LONG + ["inference.num_pages=12"])
+    eng = InferenceEngine(cfg, params)
+    r = eng.submit_request(PROMPT, 8)
+    assert r.outcome == "shed:context_too_long"
+    assert eng.robust.shed_context == 1
+    done = eng.step()
+    assert r in done
+    t = eng.reset_timing()
+    assert t["shed_context_requests"] == 1
+    assert t["shed_requests"] == 1      # the superset counter still counts
+    eng.assert_page_accounting()
+    # Feasible requests still admit normally on the same engine.
+    out = eng.generate([PROMPT[:40]], 4)
+    assert len(out[0]) == 4
+    eng.assert_page_accounting()
+
+
+def test_preempt_to_host_resumes_at_cursor():
+    """Pool-pressure preemption of a long request spills live pages to
+    host slots and re-admits at the spill-time cursor — no re-prefill —
+    byte-identical to the uninterrupted run."""
+    cfg, params = _setup(["inference.num_pages=12"])
+    eng = InferenceEngine(cfg, params)
+    r = eng.submit_request(PROMPT, 8)
+    for _ in range(3):
+        eng.step()
+    assert r.slot is not None and r.prefill_pending
+    cursor = r.prefill_done
+    eng._preempt(r)
+    assert r.slot is None and r.host_pages and r.host_cursor == cursor
+    eng.assert_page_accounting()
+    while eng.has_work():
+        eng.step()
+    assert [r.generated] == _reference() and r.outcome == "completed"
+    # Resumed, not recomputed: prefill_done never reset below the cursor.
+    assert r.prefill_done >= cursor
+    eng.assert_page_accounting()
+    t = eng.reset_timing()
+    assert t["request_paged_out"] > 0
+    assert t["request_paged_out"] == t["request_paged_in"]
+
+
+def test_preempt_below_break_even_recomputes():
+    """Below host_tier_min_tokens the recompute path wins: plain preempt
+    (no host spill), full re-prefill, same tokens."""
+    cfg, params = _setup([
+        "inference.num_pages=12",
+        "inference.host_tier_min_tokens=100000",
+    ])
+    eng = InferenceEngine(cfg, params)
+    r = eng.submit_request(PROMPT, 8)
+    for _ in range(3):
+        eng.step()
+    eng._preempt(r)
+    assert not r.host_pages and r.prefill_done == 0
+    while eng.has_work():
+        eng.step()
+    assert [r.generated] == _reference()
+    eng.assert_page_accounting()
+
+
+def test_swa_roll_drops_host_resident_page():
+    """A host-resident page the sliding window rolls past is freed from
+    the host tier directly — never restored just to die."""
+    cfg, params = _setup(["inference.num_pages=12"])
+    eng = InferenceEngine(cfg, params)
+    r = eng.submit_request(PROMPT, 8)
+    for _ in range(3):
+        eng.step()
+    assert r.prefill_done >= 64       # several pages already rolled dead
+    hp = eng._host_pool
+    # Plant host residue on a page the window is already past (the
+    # defensive path: demotion/restore racing the window's advance).
+    j = r.freed_until - 1
+    assert j >= 0 and r.pages[j] is None
+    hid = hp.alloc(1)[0]
+    r.host_pages[j] = hid
+    free_before = hp.free_slots
+    eng._roll_window()
+    assert j not in r.host_pages and hp.free_slots == free_before + 1
+    while eng.has_work():
+        eng.step()
+    assert [r.generated] == _reference()
+    eng.assert_page_accounting()
+
+
+def test_speculation_held_while_pages_nonresident():
+    """A decode-phase slot with host-resident residue (a page-in fault
+    retrying) must not draft: _propose_drafts holds it to a plain
+    1-token row until the restore lands."""
+    cfg, params = _setup([
+        "inference.num_pages=12", "inference.speculative=true",
+        "inference.decode_window=1",
+    ])
+    eng = InferenceEngine(cfg, params)
+    r = eng.submit_request(PROMPT, 6)
+    while r.prefill_pending or not r.generated:
+        eng.step()
+    assert r.slot is not None and not r.done
+    # Demote one live page by hand (the cap path does exactly this
+    # between turns) and ask for drafts: the slot is held.
+    live = [j for j in range(r.freed_until, len(r.pages))
+            if r.pages[j] is not None]
+    page = r.pages[live[0]]
+    hids = eng._spill_pages([page], tree=False)
+    assert hids is not None
+    r.host_pages[live[0]] = hids[0]
+    r.pages[live[0]] = None
+    eng.page_table[r.slot, live[0]] = 0
+    eng.alloc.free([page])
+    drafts = eng._propose_drafts([r])
+    assert drafts is None or not drafts.get(r.slot)
+    # Restore and finish: identical stream, balanced pools.
+    eng._page_in_request(r)
+    assert not r.host_pages
+    while eng.has_work():
+        eng.step()
+    assert r.generated == _reference()[0][:6]
+    eng.assert_page_accounting()
+
+
+def test_longcontext_bench_serve_smoke():
+    """tools/longcontext_bench.py --serve --smoke: the serving verdict —
+    over-pool admit-and-complete beating reject, and the paged-flash
+    per-chunk copy volume staying O(real context) — holds on CPU."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    p = subprocess.run(
+        [sys.executable, str(root / "tools/longcontext_bench.py"),
+         "--serve", "--smoke"],
+        capture_output=True, text=True, timeout=400, cwd=str(root),
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln]
+    assert lines[-1]["verdict"] == "PASS"
+    rows = [ln for ln in lines if "S" in ln]
+    assert all(r["paged_flash"]["outcome"] == "completed" for r in rows)
+    assert all(r["reject_baseline_refuses"] for r in rows)
+
+
+def test_restore_fault_mid_page_in():
+    """Chaos pin (FaultSpec kind="restore"): a fault mid-page-in fails
+    the step, unwinds the device side, KEEPS the host refs, and the
+    retry completes byte-identical with both pools balanced."""
+    cfg, params = _setup([
+        "inference.num_pages=12", "inference.request_resident_pages=1",
+    ])
+    inj = FaultInjector()
+    eng = InferenceEngine(cfg, params, fault_injector=inj)
+    r = eng.submit_request(PROMPT, 8)
+    for _ in range(2):
+        eng.step()
+    assert r.host_pages, "cap=1 must have demoted by now"
+    held = dict(r.host_pages)
+    inj.specs.append(FaultSpec("restore", step=eng.step_no))
+    eng.step()
+    assert eng.robust.failed_steps == 1
+    assert r.host_pages == held, "host refs must survive the fault"
+    eng.assert_page_accounting()
+    while eng.has_work():
+        eng.step()
+    assert [r.generated] == _reference() and r.outcome == "completed"
+    eng.assert_page_accounting()
+    t = eng.reset_timing()
+    assert t["dispatch_faults"] >= 1 and t["failed_steps"] == 1

@@ -82,6 +82,13 @@ GRID_VARIANTS: dict = {
     "mixed_hygiene": [
         ["inference.kv_quant=int8"],
     ],
+    "long_prefill_hygiene": [
+        ["inference.kv_quant=int8"],
+        # The paged-flash prefill body, interpret-lowered on CPU: the
+        # kernel must not smuggle host callbacks into the mixed program
+        # (pallas interpret mode stages pure jax primitives).
+        ["model.kernels=pallas_interpret"],
+    ],
 }
 
 

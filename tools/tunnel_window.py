@@ -51,6 +51,13 @@ QUEUE = [
      [sys.executable, str(ROOT / "tools/moe_dispatch_bench.py")], 1800),
     ("longcontext",
      [sys.executable, str(ROOT / "tools/longcontext_bench.py")], 2700),
+    # Long-context SERVING probe (ISSUE 19): TTFT/ITL for the paged-flash
+    # prefill body vs the XLA reference at 8k/16k/32k contexts, plus the
+    # over-pool admit-and-complete vs reject verdict on-chip (the
+    # --smoke twin rides tier-1 in tests/test_long_context.py).
+    ("longcontext_serve",
+     [sys.executable, str(ROOT / "tools/longcontext_bench.py"),
+      "--serve"], 2700),
     ("prefill_burst",
      [sys.executable, str(ROOT / "tools/prefill_burst_bench.py")], 1800),
     # Tree-speculation serve probes (ISSUE 11): chain vs tree drafting x
