@@ -533,6 +533,12 @@ ENGINE_PROGRAMS = (
     # switching None -> array is a distinct jit specialization, so the
     # masked trace gets its own contract row.
     "verify_masked",
+    # The KV-page migration envelope halves (ISSUE 20): the batched
+    # gather (export — a pure pool read, NO donation) and the batched
+    # scatter (import — donates the destination cache). One dispatch per
+    # page batch by construction; the contracts pin that neither half
+    # smuggles in host callbacks, f64, finiteness ops or collectives.
+    "migrate_gather", "migrate_scatter",
 )
 
 
@@ -654,6 +660,21 @@ def _engine_call(eng, program: str):
         )
         extra = sampling if program == "decode" else ()
         return getattr(eng, "_" + program), common + extra, {}
+
+    if program in ("migrate_gather", "migrate_scatter"):
+        # The migration copy envelope (ISSUE 20): pow2-padded page-id
+        # batches, exactly as export_migration_pages / import_pages
+        # assemble them. Gather reads the pool (no donation); scatter
+        # donates the destination cache (executor donate_argnums=(0,)).
+        pages = np.zeros(8, i32)
+        if program == "migrate_gather":
+            return eng._gather_pages, (eng.cache, pages), {}
+        L = eng.mcfg.n_layers
+        blocks = {
+            name: np.zeros((8, L) + arr.shape[1:], arr.dtype)
+            for name, arr in eng.cache.items()
+        }
+        return eng._scatter_pages, (eng.cache, pages, blocks), {}
 
     if program == "prefill":
         S = eng.icfg.prefill_chunk
@@ -1009,6 +1030,37 @@ def _registry() -> dict[str, Contract]:
         predicates=eng_hygiene,
         doc="host-tier x speculation: the verify dispatch is equally "
             "untouched by the tier (no callbacks, donation complete)",
+    )
+    # Zero-collective pin shared by both migration envelope halves: a
+    # single-replica page copy is pure pool traffic — ONE dispatch per
+    # pow2-padded page batch with no collective fan-out (a per-page
+    # dispatch blowup would show up as N gathers in the bench, but a
+    # collective sneaking into the copy program would show up HERE).
+    _mig_no_collectives = collective_inventory(
+        all_gather=0, reduce_scatter=0, all_reduce=0,
+        collective_permute=0, all_to_all=0,
+    )
+    add(
+        "migration_hygiene", "migrate_gather",
+        predicates=(no_f64, no_host_callbacks, no_finiteness_ops,
+                    _mig_no_collectives),
+        smoke=True,
+        doc="migration export half (ISSUE 20): the batched page gather "
+            "feeding a prefill->decode handoff stages no host callbacks/"
+            "f64/finiteness ops and zero collectives per page batch. "
+            "Deliberately NO donation predicate: the gather is a pure "
+            "pool read (the source request keeps serving if the handoff "
+            "dies), so nothing is donated by design",
+    )
+    add(
+        "migration_scatter_hygiene", "migrate_scatter",
+        predicates=eng_hygiene + (_mig_no_collectives,),
+        smoke=True,
+        doc="migration import half (ISSUE 20): the batched page scatter "
+            "admitting migrated KV into the decode replica's pool — same "
+            "hygiene, zero collectives, and the destination cache "
+            "donation fully aliased (a leaked alias would double the "
+            "decode pool for the copy step)",
     )
     add(
         "tp_decode_collectives", "decode_defaults",

@@ -158,6 +158,10 @@ _DISPATCH_SCOPE = {
         # Per-request KV paging (ISSUE 19): the batched page-in restore
         # is the same documented one-h2d envelope.
         "_page_in",
+        # KV-page migration (ISSUE 20): the gather/scatter copy envelopes
+        # run from the router's serving loop — one sync per batch each
+        # (justified allows).
+        "export_migration", "import_pages",
     ),
 }
 

@@ -1124,11 +1124,40 @@ class RouterConfig:
     decision_log: int = 16
     # Backoff-jitter PRNG seed (placement itself is deterministic).
     seed: int = 0
+    # Disaggregated prefill/decode serving (ISSUE 20): "prefill:K,decode:M"
+    # splits the fleet into K prefill replicas (take new submissions, run
+    # prompts, then hand the request off) and M decode replicas (accept
+    # only migrated-in work, admitted as zero-prefill warm starts off the
+    # migrated KV pages). K + M must equal ``replicas``; the replica
+    # indices assign in spec order (prefill first). Unset = today's
+    # symmetric fleet, byte-identical behavior.
+    roles: Optional[str] = None
+    # Migrate after EVERY completed prefill chunk instead of once at
+    # prompt completion — overlaps migration with the remaining prefill
+    # at the cost of one copy envelope per chunk. Requires roles.
+    migrate_per_chunk: bool = False
 
     def __post_init__(self):
         if self.replicas is None or self.replicas < 1:
             raise ValueError(
                 f"router.replicas={self.replicas} must be >= 1"
+            )
+        if self.roles is not None:
+            counts = parse_roles(self.roles)
+            total = sum(counts.values())
+            if total != self.replicas:
+                raise ValueError(
+                    f"router.roles={self.roles!r} names {total} replicas "
+                    f"but router.replicas={self.replicas}"
+                )
+            if counts.get("prefill", 0) < 1 or counts.get("decode", 0) < 1:
+                raise ValueError(
+                    f"router.roles={self.roles!r} needs at least one "
+                    "prefill and one decode replica"
+                )
+        if self.migrate_per_chunk and self.roles is None:
+            raise ValueError(
+                "router.migrate_per_chunk requires router.roles"
             )
         if self.retry_budget is None or self.retry_budget < 0:
             raise ValueError(
@@ -1206,6 +1235,44 @@ def parse_per_class(spec: str) -> dict[int, dict[str, float]]:
                 f"slo.per_class repeats class {cls}"
             )
         out[cls] = targets
+    return out
+
+
+def parse_roles(spec: str) -> dict[str, int]:
+    """Parse the ``router.roles`` disaggregation spec: comma-separated
+    ``<role>:<count>`` entries, e.g. ``"prefill:1,decode:2"``. Roles are
+    ``prefill`` | ``decode``; returns ``{role: count}``. Lives in
+    config.py (pure string parsing, no deps) so RouterConfig validation
+    and infer/router.py's role assignment share ONE grammar."""
+    out: dict[str, int] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"router.roles entry {entry!r} needs <role>:<count>"
+            )
+        role, count_s = (s.strip() for s in entry.split(":", 1))
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"router.roles role {role!r} must be prefill|decode"
+            )
+        try:
+            count = int(count_s)
+        except ValueError as e:
+            raise ValueError(
+                f"router.roles count {count_s!r} is not an int"
+            ) from e
+        if count < 1:
+            raise ValueError(
+                f"router.roles count {role}:{count} must be >= 1"
+            )
+        if role in out:
+            raise ValueError(f"router.roles repeats role {role}")
+        out[role] = count
+    if not out:
+        raise ValueError(f"router.roles={spec!r} names no roles")
     return out
 
 

@@ -23,7 +23,7 @@ import contextlib
 import itertools
 import logging
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Optional, Sequence
 
 import jax
@@ -68,6 +68,26 @@ from orion_tpu.runtime.fault import (
 )
 
 log = logging.getLogger("orion_tpu.infer")
+
+
+@lru_cache(maxsize=None)
+def _gather_pages_jit(n_layers: int, num_pages: int):
+    """Process-wide jitted batched page gather, keyed by pool geometry:
+    fleet replicas in one process (infer.Router) share the compiled
+    executables instead of each engine re-compiling its own — a
+    migration's scatter compile on a decode replica would otherwise land
+    in that replica's serving clock."""
+    return jax.jit(
+        partial(gather_pages, n_layers=n_layers, num_pages=num_pages),
+    )
+
+
+@lru_cache(maxsize=None)
+def _scatter_pages_jit(n_layers: int, num_pages: int):
+    return jax.jit(
+        partial(scatter_pages, n_layers=n_layers, num_pages=num_pages),
+        donate_argnums=(0,),
+    )
 
 
 def _detect_tp_mesh(params: Any, axis: str = "tp"):
@@ -205,6 +225,18 @@ class InferenceEngine:
         # byte-identical to the untiered one.
         self._host_pool: Optional[HostPagePool] = None
         self._host_min_tokens: float = 0.0
+        # Batched page-copy programs, shared by the host tier's spill/
+        # restore envelopes AND cross-replica KV-page migration (ISSUE
+        # 20) — built unconditionally so a tier-off prefill replica can
+        # still export pages. gather is a pure pool read (no donation);
+        # scatter donates the pool like every other cache-updating
+        # program.
+        self._gather_pages = _gather_pages_jit(
+            self.mcfg.n_layers, self.icfg.num_pages
+        )
+        self._scatter_pages = _scatter_pages_jit(
+            self.mcfg.n_layers, self.icfg.num_pages
+        )
         if self.icfg.host_tier_bytes > 0:
             if not (self.icfg.prefix_cache or self._long):
                 raise ValueError(
@@ -222,21 +254,6 @@ class InferenceEngine:
                     f"bytes); raise it or disable the tier with 0"
                 )
             self._host_pool = HostPagePool(cap, page_bytes=pb)
-            self._gather_pages = jax.jit(
-                partial(
-                    gather_pages,
-                    n_layers=self.mcfg.n_layers,
-                    num_pages=self.icfg.num_pages,
-                ),
-            )
-            self._scatter_pages = jax.jit(
-                partial(
-                    scatter_pages,
-                    n_layers=self.mcfg.n_layers,
-                    num_pages=self.icfg.num_pages,
-                ),
-                donate_argnums=(0,),
-            )
             # Break-even gate: explicit knob wins; otherwise derive from
             # the measured constants (PERF.md "Host-tier break-even").
             # None from the arithmetic means restore NEVER wins — the
@@ -317,11 +334,17 @@ class InferenceEngine:
         # "shed:context_too_long" outcome covers that case instead).
         self._lazy = self._long and self.page_window is not None
         self._dev_span = 0.0
+        self._mixed_span = 0.0
         self._prefill_span = 0.0
         self._spill_span = 0.0
         self._restore_span = 0.0
         self._pagein_span = 0.0
+        self._migrate_span = 0.0
         self.timing = self._zero_timing()
+        # Cross-replica migration staging (ISSUE 20): requests whose KV
+        # pages are arriving from a prefill replica but have not claimed
+        # a slot yet. Page owners for assert_page_accounting.
+        self._importing: dict[int, Request] = {}
 
         # -- Fault tolerance (runtime/fault.py; README "Robustness") -------
         self._injector = fault_injector
@@ -1119,6 +1142,7 @@ class InferenceEngine:
             # jit compile must not trip a false stall.
             self._watchdog.heartbeat()
         self._dev_span = 0.0
+        self._mixed_span = 0.0
         self._prefill_span = 0.0
         self._spill_span = 0.0
         self._restore_span = 0.0
@@ -1167,7 +1191,15 @@ class InferenceEngine:
                 raise
             decoded = False
         total = time.perf_counter() - t0
-        self.timing["device_s"] += self._dev_span
+        # device_s keeps its historical meaning (every decode-facing
+        # dispatch, mixed chunk+decode included); the per-phase split
+        # rides alongside so the router's ITL-proxy tiebreak can read
+        # PURE decode time — a replica grinding a long prompt through
+        # mixed steps no longer looks "slow to decode" (ISSUE 20
+        # load-gauge satellite).
+        self.timing["device_s"] += self._dev_span + self._mixed_span
+        self.timing["decode_device_s"] += self._dev_span
+        self.timing["mixed_device_s"] += self._mixed_span
         self.timing["prefill_s"] += self._prefill_span
         # Host-tier copy spans get their own buckets (the bench derives
         # real d2h/h2d bandwidth from them); they are neither decode
@@ -1176,7 +1208,7 @@ class InferenceEngine:
         self.timing["restore_s"] += self._restore_span
         self.timing["page_in_s"] += self._pagein_span
         self.timing["host_s"] += (
-            total - self._dev_span - self._prefill_span
+            total - self._dev_span - self._mixed_span - self._prefill_span
             - self._spill_span - self._restore_span - self._pagein_span
         )
         self.timing["steps"] += 1
@@ -1254,11 +1286,22 @@ class InferenceEngine:
     def _zero_timing() -> dict:
         return {
             "device_s": 0.0, "host_s": 0.0, "prefill_s": 0.0,
+            # Per-phase device split (ISSUE 20 load-gauge satellite):
+            # decode_device_s covers pure decode-phase dispatches
+            # (decode windows, verify, draft compaction) and pairs with
+            # decode_slot_steps for a phase-pure ITL proxy;
+            # mixed_device_s covers chunk-carrying mixed dispatches
+            # whose wall time fuses prompt and decode work.
+            # device_s == decode_device_s + mixed_device_s, unchanged.
+            "decode_device_s": 0.0, "mixed_device_s": 0.0,
             "windows": 0, "steps": 0,
             # Decode-waste accounting: slot_steps counts (active slot x
             # inner decode step) work the device performed; wasted_steps
             # the share discarded because the slot finished mid-window.
-            "slot_steps": 0, "wasted_steps": 0,
+            # decode_slot_steps is the pure decode-window/verify subset
+            # (mixed steps' decode rows excluded, matching
+            # decode_device_s's numerator).
+            "slot_steps": 0, "wasted_steps": 0, "decode_slot_steps": 0,
             # Chunked-prefill accounting: mixed_steps counts unified
             # dispatches, chunk_tokens the real prompt tokens they carried,
             # chunk_pad_tokens the padded-out chunk positions (the chunk-
@@ -1273,6 +1316,11 @@ class InferenceEngine:
             # long_context): restores of a live request's own host-
             # resident pages ahead of the dispatch that reads them.
             "spill_s": 0.0, "restore_s": 0.0, "page_in_s": 0.0,
+            # Cross-replica KV migration copy time (ISSUE 20): the
+            # batched gather on the export side / scatter on the import
+            # side. Both run OUTSIDE step() (router-driven) and flush
+            # directly, like offload_prefix_cache's spill span.
+            "migrate_out_s": 0.0, "migrate_in_s": 0.0,
         }
 
     def reset_timing(self) -> dict:
@@ -1501,6 +1549,7 @@ class InferenceEngine:
         refs = [0] * n
         owners = [r for r in self.slots if r is not None]
         owners += list(self.waiting) + list(self._just_finished)
+        owners += list(self._importing.values())
         for req in owners:
             for p in req.pages:
                 if p is not None:
@@ -2265,6 +2314,344 @@ class InferenceEngine:
                 **self._trace_ctx(req),
             )
         return need - n
+
+    # -- cross-replica KV-page migration (ISSUE 20; infer/router.py
+    #    drives these between steps for role-split fleets) ----------------
+    #
+    # Export half (the prefill replica): migration_ready /
+    # migration_full_pages gate the handoff, export_migration_state
+    # snapshots the host-side request state, export_migration_pages runs
+    # the batched gather (the spill envelope's read half — int8 scale
+    # pools ride the cache dict), finish_migration tears the slot down
+    # WITHOUT a typed outcome once the destination committed (fleet-level
+    # exactly-once surfacing moves with the request; full context pages
+    # still donate to the source prefix tree on the way out).
+    #
+    # Import half (the decode replica): import_begin stages a Request
+    # with no slot, import_pages allocates fresh pool pages and scatters
+    # migrated blocks into them (the restore envelope's write half, same
+    # unwind discipline), import_commit claims a slot and resumes decode
+    # at the source cursor — a zero-prefill warm start, byte-identical
+    # greedy continuation — and import_abort unwinds a torn handoff.
+    # Staged requests are page owners (assert_page_accounting walks
+    # them); a commit deferred on a full batch leaves the request WHOLLY
+    # arrived, just unscheduled.
+
+    def _active_request(self, rid: int) -> Optional[Request]:
+        for r in self.slots:
+            if r is not None and r.rid == rid and not r.done:
+                return r
+        return None
+
+    def migration_ready(self, rid: int) -> bool:
+        """Whole-request handoff can run: the prompt is fully prefilled
+        and the first token sampled (both prefill paths sample it at
+        prompt completion), so the destination resumes in pure decode."""
+        req = self._active_request(rid)
+        return (
+            req is not None
+            and not req.prefill_pending
+            and bool(req.generated)
+        )
+
+    def migration_in_prefill(self, rid: int) -> bool:
+        """The request is mid-chunked-prefill on a live slot — the
+        per-chunk streaming mode (router.migrate_per_chunk) can open its
+        stream and ship completed full pages ahead of the final commit."""
+        req = self._active_request(rid)
+        return req is not None and req.prefill_pending
+
+    def migration_full_pages(self, rid: int) -> int:
+        """Leading logical pages whose KV is final (wholly covered by the
+        prefill chunk cursor / decode cursor): the per-chunk streaming
+        watermark — a full page never mutates, so pages below this index
+        ship once and stay valid."""
+        req = self._active_request(rid)
+        if req is None:
+            return 0
+        cursor = (
+            req.prefill_done if req.prefill_pending
+            else int(self.seq_lens[req.slot])
+        )
+        return min(cursor // self.psz, len(req.pages))
+
+    def export_migration_state(self, rid: int) -> dict:
+        """Host-side snapshot of everything the destination needs beyond
+        the KV bytes: identity + sampling overrides, the decode cursor
+        and in-flight token, the SWA rolling mark, and the grammar
+        ``ConstraintState`` walk (pure host state — it moves with the
+        request). No device work; call at commit time so the snapshot
+        matches the shipped pages."""
+        req = self._active_request(rid)
+        if req is None:
+            raise ValueError(f"no active request {rid} to export")
+        slot = req.slot
+        return {
+            "prompt": list(req.prompt),
+            "generated": list(req.generated),
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "top_p": req.top_p,
+            "priority": req.priority,
+            "deadline": req.deadline,
+            "trace_id": req.trace_id,
+            "attempt": req.attempt,
+            "constraint": req.constraint,
+            "cursor": int(self.seq_lens[slot]),
+            "last_token": int(self.last_token[slot]),
+            "prefill_pending": req.prefill_pending,
+            "prefill_done": req.prefill_done,
+            "freed_until": req.freed_until,
+            "n_logical": len(req.pages),
+            "page_size": self.psz,
+        }
+
+    def export_migration_pages(
+        self, rid: int, start: int = 0, stop: Optional[int] = None
+    ):
+        """Batched gather of the live pages in logical span [start, stop)
+        — ONE dispatch + the blocks as DEVICE arrays (``[npad, L, ...]``
+        per cache array, int8 scale pools included), so the router can
+        convert topology through ``parallel/reshard.py`` (or
+        ``jax.device_get`` for the universal host hop) before the
+        destination scatter. Host-tier-resident pages page in FIRST
+        (restore-before-migrate): the gather needs device bytes, and the
+        page-in envelope's unwind already covers its faults. Returns
+        ``(live, blocks)`` with ``live`` the absolute logical indices
+        gathered. The source request is untouched — gather is a pure pool
+        read, so a failed handoff leaves it serving colocated."""
+        req = self._active_request(rid)
+        if req is None:
+            raise ValueError(f"no active request {rid} to export")
+        if req.host_pages:
+            self._page_in_request(req)
+        if stop is None:
+            stop = len(req.pages)
+        live = [
+            j for j in range(start, min(stop, len(req.pages)))
+            if req.pages[j] is not None
+        ]
+        if not live:
+            return [], {}
+        n = len(live)
+        npad = 1 << (n - 1).bit_length()
+        padded = np.zeros(npad, np.int32)
+        padded[:n] = [req.pages[j] for j in live]
+        self._migrate_span = 0.0
+        try:
+            with self._device_span("migrate_out", "_migrate_span"), \
+                    self._tracer.annotation("orion/migrate_out"):
+                blocks = self._gather_pages(self.cache, jnp.asarray(padded))
+                jax.block_until_ready(blocks)  # orion: allow[host-sync] a torn gather must surface HERE, not inside the destination scatter
+        # orion: allow[fault-except] migrate-out envelope: pure read — nothing to unwind; typed DispatchFault, source request intact
+        except Exception as e:
+            self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path="migrate_out",
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise DispatchFault(
+                "migrate_out", f"{type(e).__name__}: {e}"
+            ) from e
+        # Runs OUTSIDE step() (same contract as offload_prefix_cache):
+        # flush the copy span straight into the timing bucket.
+        self.timing["migrate_out_s"] += self._migrate_span
+        self._migrate_span = 0.0
+        return live, blocks
+
+    def finish_migration(self, rid: int) -> None:
+        """Source-side commit: the destination holds the whole request —
+        tear the slot down with NO typed outcome (the request surfaces
+        exactly once, from the destination), donating full context pages
+        to the source prefix tree exactly like a reap would, so the
+        source stays warm for affinity-matched followers."""
+        req = self._active_request(rid)
+        if req is None:
+            return
+        cursor = int(self.seq_lens[req.slot])
+        self._teardown_slot(req, cursor)
+        req.done = True
+        self._ttft_seen.discard(req.rid)
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "migrate_out", rid=req.rid, cursor=cursor,
+                step=self.step_no, **self._trace_ctx(req),
+            )
+
+    def import_begin(self, state: dict) -> int:
+        """Stage an incoming migration: a Request with no slot, owning
+        pages as they arrive (import_pages). Returns the engine rid the
+        router uses as the stream token. Validates the ONE layout
+        parameter the page copy cannot convert — page geometry; pool
+        sizes, shardings and dtypes convert in transit."""
+        if state["page_size"] != self.psz:
+            raise ValueError(
+                f"migration page_size {state['page_size']} != "
+                f"destination page_size {self.psz} (page-granular copies "
+                f"cannot re-chunk; match inference.page_size across roles)"
+            )
+        req = Request(
+            rid=next(self._rid),
+            prompt=list(state["prompt"]),
+            max_new_tokens=state["max_new_tokens"],
+            temperature=state["temperature"],
+            top_k=state["top_k"],
+            top_p=state["top_p"],
+            priority=state["priority"],
+            deadline=state["deadline"],
+            trace_id=state["trace_id"],
+            attempt=state["attempt"],
+            constraint=state["constraint"],
+        )
+        self._importing[req.rid] = req
+        return req.rid
+
+    def import_pages(self, token: int, live: list, blocks: dict) -> None:
+        """Scatter one batch of migrated page blocks into fresh pool
+        pages at the staged request's logical indices ``live``. The write
+        half of the restore envelope with the same unwind: a fault frees
+        the fresh pages and raises a typed DispatchFault with the staged
+        request unchanged — the router aborts or retries; no torn page
+        either way."""
+        req = self._importing[token]
+        n = len(live)
+        fresh = self._alloc_pages(n)
+        try:
+            npad = 1 << (n - 1).bit_length()
+            padded = np.zeros(npad, np.int32)
+            padded[:n] = fresh
+            self._migrate_span = 0.0
+            with self._device_span("migrate_in", "_migrate_span"), \
+                    self._tracer.annotation("orion/migrate_in"):
+                self.cache = self._scatter_pages(
+                    self.cache, jnp.asarray(padded),
+                    {k: jnp.asarray(v) for k, v in blocks.items()},
+                )
+                jax.block_until_ready(self.cache)  # orion: allow[host-sync] the ONE sync per migrate-in batch — a torn copy must surface BEFORE the commit
+        # orion: allow[fault-except] migrate-in envelope: free the fresh pages, keep the staged request, typed DispatchFault
+        except Exception as e:
+            self.alloc.free(fresh)
+            self.robust.dispatch_faults += 1
+            self._flight_note(
+                "dispatch_fault", path="migrate_in",
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise DispatchFault(
+                "migrate_in", f"{type(e).__name__}: {e}"
+            ) from e
+        self.timing["migrate_in_s"] += self._migrate_span
+        self._migrate_span = 0.0
+        if live and max(live) >= len(req.pages):
+            req.pages.extend([None] * (max(live) + 1 - len(req.pages)))
+        for j, p in zip(live, fresh):
+            req.pages[j] = p
+
+    def import_commit(self, token: int, state: dict) -> Optional[Request]:
+        """Admit the staged request as a zero-prefill warm start: claim a
+        free slot, mirror the source's page layout and cursors, resume
+        decode on the in-flight token. Returns the live Request, or None
+        when no slot (or no first-window page headroom) is free — the
+        request stays staged, WHOLLY arrived, and the router retries the
+        commit next step. Mirrors _readmit_host's slot restore exactly;
+        the decode stream continues byte-identical to a colocated serve
+        for greedy requests (argmax is key-independent — sampled streams
+        draw from the destination engine's key lineage, the same caveat
+        as the prefix cache's zero-prefill path)."""
+        req = self._importing[token]
+        slot = next(
+            (i for i, r in enumerate(self.slots) if r is None), None
+        )
+        if slot is None:
+            return None
+        n_logical = max(state["n_logical"], len(req.pages))
+        cursor = state["cursor"]
+        last = min(
+            cursor + self._provision_window - 1, self.icfg.max_seq_len - 1
+        )
+        first_window = min(last // self.psz + 1, self.pages_per_seq)
+        headroom = max(first_window - n_logical, 0) + 1
+        if self._available() < headroom:
+            return None
+        del self._importing[token]
+        if len(req.pages) < n_logical:
+            req.pages.extend([None] * (n_logical - len(req.pages)))
+        req.generated = list(state["generated"])
+        req.constraint = state["constraint"]
+        req.freed_until = state["freed_until"]
+        # The source's SWA window may have rolled past pages shipped
+        # earlier in a per-chunk stream: they are dead at commit — free
+        # them now, exactly as the source's _roll_window did.
+        stale = [
+            j for j in range(min(req.freed_until, len(req.pages)))
+            if req.pages[j] is not None
+        ]
+        if stale:
+            self.alloc.free([req.pages[j] for j in stale])
+            for j in stale:
+                req.pages[j] = None
+        req.slot = slot
+        req.admit_seq = next(self._admit_seq)
+        self.slots[slot] = req
+        icfg = self.icfg
+        self.slot_temp[slot] = (
+            icfg.temperature if req.temperature is None
+            else req.temperature
+        )
+        self.slot_top_k[slot] = (
+            icfg.top_k if req.top_k is None else req.top_k
+        )
+        self.slot_top_p[slot] = (
+            icfg.top_p if req.top_p is None else req.top_p
+        )
+        self.page_table[slot] = 0
+        self.page_table[slot, :len(req.pages)] = [
+            0 if p is None else p for p in req.pages
+        ]
+        self.seq_lens[slot] = cursor
+        self.last_token[slot] = state["last_token"]
+        req.prefill_done = state["prefill_done"]
+        req.prefill_pending = state["prefill_pending"]
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "migrate_in", rid=req.rid, slot=slot, cursor=cursor,
+                step=self.step_no, **self._trace_ctx(req),
+            )
+        return req
+
+    def import_abort(self, token: int) -> None:
+        """Unwind a torn/abandoned migration stream: free every staged
+        page and drop the staged request. Idempotent (a commit already
+        consumed the token -> no-op), so the router's failure paths can
+        call it unconditionally."""
+        req = self._importing.pop(token, None)
+        if req is None:
+            return
+        self.alloc.free([p for p in req.pages if p is not None])
+        req.pages = []
+
+    def migration_block_shardings(self) -> Optional[dict]:
+        """Target shardings for migrated-in page blocks, one per cache
+        array: this pool's own sharding with the leading pool-row dim
+        replaced by the block batch dims (``[rows, ...] -> [n, L, ...]``)
+        so `parallel/reshard.py` can move a source replica's gathered
+        blocks straight onto this replica's layout — the manifest-style
+        per-array redistribution, without a host bounce when source and
+        destination share a platform. Returns None when any pool array
+        carries no usable sharding (the router then falls back to the
+        universal jax.device_get hop)."""
+        out = {}
+        for name, arr in self.cache.items():
+            sh = getattr(arr, "sharding", None)
+            if sh is None:
+                return None
+            if isinstance(sh, jax.sharding.NamedSharding):
+                spec = jax.sharding.PartitionSpec(None, None, *sh.spec[1:])
+                out[name] = jax.sharding.NamedSharding(sh.mesh, spec)
+            else:
+                # Single-device pool: place blocks on the same device.
+                out[name] = sh
+        return out
 
     def _admit(self) -> None:
         # Pass 1 (host): claim slots + pages for every admissible request,
@@ -3087,6 +3474,7 @@ class InferenceEngine:
                 acc, alt = jax.device_get((acc, alt))   # orion: allow[host-sync] the verify step's ONE documented fetch
                 okh = None
         self.timing["slot_steps"] += len(active)
+        self.timing["decode_slot_steps"] += len(active)
         if okh is not None:
             for req in active:
                 if not okh[req.slot]:
@@ -3376,6 +3764,7 @@ class InferenceEngine:
                 tokens = np.asarray(jax.device_get(toks))  # orion: allow[host-sync] [W, B] — the decode window's ONE documented fetch
                 okh = None
         self.timing["slot_steps"] += W * len(active)
+        self.timing["decode_slot_steps"] += W * len(active)
         if okh is not None:
             for req in active:
                 if not okh[req.slot]:
@@ -3604,7 +3993,7 @@ class InferenceEngine:
                 jnp.asarray(mask),
                 sub,
             ) + chunk_args
-            with self._device_span("mixed_verify"):
+            with self._device_span("mixed_verify", "_mixed_span"):
                 if defaults:
                     out = self._run_dispatch(
                         "mixed_verify", "mixed_verify_defaults", *common,
@@ -3632,7 +4021,7 @@ class InferenceEngine:
                 jnp.asarray(mask),
                 sub,
             ) + chunk_args
-            with self._device_span("mixed"):
+            with self._device_span("mixed", "_mixed_span"):
                 if defaults:
                     out = self._run_dispatch(
                         "mixed", "mixed_defaults", *common

@@ -66,7 +66,9 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
-from orion_tpu.config import Config
+import jax
+
+from orion_tpu.config import Config, parse_roles
 from orion_tpu.infer.engine import InferenceEngine
 from orion_tpu.infer.scheduler import Request
 from orion_tpu.metrics import RouterStats
@@ -78,10 +80,12 @@ from orion_tpu.obs import (
     merge_chrome_safe,
     namespaced_path,
 )
+from orion_tpu.parallel.reshard import reshard
 from orion_tpu.runtime.fault import (
     DispatchFault,
     FaultInjector,
     FaultSpec,
+    InjectedFault,
 )
 
 log = logging.getLogger("orion_tpu.router")
@@ -146,6 +150,24 @@ class RouterRequest:
         return bool(self.outcome)
 
 
+@dataclass
+class MigrationStream:
+    """One in-flight prefill->decode KV handoff (ISSUE 20): the staged
+    request on the destination (``token`` is its engine rid) plus the
+    full-page watermark already shipped (``router.migrate_per_chunk``
+    streams pages during chunked prefill; whole-request mode ships once
+    at commit). The stream dies with either endpoint — the destination
+    staging is aborted and, when the SOURCE died, the request re-queues
+    with a typed ``retried`` tag: never half a context."""
+
+    src: int                    # prefill replica index
+    dst: int                    # decode replica index
+    token: int                  # destination engine rid (staging key)
+    t0: float                   # perf_counter at stream open (latency)
+    shipped: int = 0            # logical pages already on the destination
+    pages: int = 0              # total pages shipped (metrics)
+
+
 class ReplicaHandle:
     """One replica: the engine, its dedicated fault injector (the funnel
     replica-scoped fault specs forward through) and the breaker state."""
@@ -155,6 +177,10 @@ class ReplicaHandle:
         self.idx = idx
         self.engine = engine
         self.injector = injector
+        # Replica role (router.roles; ISSUE 20): None on a symmetric
+        # fleet. "prefill" replicas take new placements and hand decode
+        # work off; "decode" replicas accept only migrated-in requests.
+        self.role: Optional[str] = None
         self.state = CLOSED
         self.dead = False           # killed: never stepped again
         self.opened_at = 0          # router step of the last OPEN trip
@@ -226,6 +252,28 @@ class Router:
                 fault_injector=inj,
             )
             self.handles.append(ReplicaHandle(i, eng, inj))
+        # Role-split fleet (router.roles; ISSUE 20): assign roles in spec
+        # order — "prefill:1,decode:2" marks replica 0 prefill, 1-2
+        # decode. Unset = today's symmetric fleet, byte-identical.
+        self._roles = (
+            parse_roles(self.rcfg.roles) if self.rcfg.roles else None
+        )
+        if self._roles is not None:
+            order = [
+                role for role, k in self._roles.items() for _ in range(k)
+            ]
+            for h, role in zip(self.handles, order):
+                h.role = role
+        # Live prefill->decode handoffs, keyed by ROUTER rid; plus the
+        # per-request failure tally and the give-up set (a request whose
+        # handoff failed past retry_budget decodes colocated on its
+        # prefill replica — still exactly one typed outcome).
+        self._migrations: dict[int, MigrationStream] = {}
+        self._mig_failures: dict[int, int] = {}
+        self._mig_exhausted: set[int] = set()
+        # Committed handoff wall-times (begin -> commit), for benches;
+        # cleared by reset_timing() with the rest of the counters.
+        self.migration_latencies: list[float] = []
         self._injector = fault_injector
         self.stats = RouterStats()
         self.step_no = 0
@@ -274,7 +322,7 @@ class Router:
         by_state = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
         for h in self.handles:
             by_state[h.state] += 1
-        return {
+        out = {
             **self.stats.as_timing(),
             "replicas": len(self.handles),
             "replicas_closed": by_state[CLOSED],
@@ -284,6 +332,23 @@ class Router:
             "queue_depth": len(self.waiting),
             "step_no": self.step_no,
         }
+        if self._roles is not None:
+            # Per-role breaker/load view (ISSUE 20): prefill saturation
+            # and decode saturation are DIFFERENT bottlenecks — a scrape
+            # must see each role's routable count and inflight depth as
+            # its own autoscale signal, not a fleet blur.
+            for role in self._roles:
+                hs = [h for h in self.handles if h.role == role]
+                out[f"{role}_replicas"] = len(hs)
+                out[f"{role}_routable"] = sum(
+                    1 for h in hs if h.routable
+                )
+                out[f"{role}_dead"] = sum(1 for h in hs if h.dead)
+                out[f"{role}_inflight"] = sum(
+                    len(h.inflight) for h in hs
+                )
+            out["migrations_inflight"] = len(self._migrations)
+        return out
 
     def _fleet_metrics(self) -> dict:
         """Fleet rollups (the ``fleet`` registry section): aggregate
@@ -370,6 +435,7 @@ class Router:
             # exported row carries the window being drained, not zeros.
             row = self.registry.snapshot()
         self.stats = RouterStats()
+        self.migration_latencies = []
         if self.icfg.metrics_jsonl or self.icfg.metrics_prom:
             try:
                 if self.icfg.metrics_jsonl:
@@ -507,6 +573,8 @@ class Router:
                 if rr is None:
                     continue    # failed over / cancelled by the router
                 self._finish(h, rr, er, done)
+        if self._roles is not None:
+            self._drive_migrations(done)
         if self._slo is not None:
             self._observe_slo(done)
         self.step_no += 1
@@ -773,6 +841,18 @@ class Router:
             # where it was when the breaker opened (ISSUE 14 satellite).
             recent_routes=list(self._decisions),
         )
+        # Migration streams touching the broken replica die with it
+        # (ISSUE 20): as the DESTINATION, the staged pages are aborted
+        # (or gone with the process) and the source keeps serving — a
+        # later step re-opens a stream to a surviving decode replica. As
+        # the SOURCE, the victim loop below re-queues the request with a
+        # typed ``retried`` tag, counted in migrations_requeued: the
+        # decode side never admits half a context (commit is atomic).
+        for rid, st in list(self._migrations.items()):
+            if st.dst == h.idx:
+                if not h.dead:
+                    h.engine.import_abort(st.token)
+                del self._migrations[rid]
         victims = list(h.inflight.values())
         h.inflight.clear()
         for rr in victims:
@@ -786,6 +866,17 @@ class Router:
                 h.engine.cancel(rr.attempt.rid)
             rr.attempt = None
             rr.replica = None
+            st = self._migrations.pop(rr.rid, None)
+            if st is not None:
+                dsth = self.handles[st.dst]
+                if not dsth.dead:
+                    dsth.engine.import_abort(st.token)
+                self.stats.migrations_requeued += 1
+                self._requeue(
+                    rr, done, f"replica {h.idx} died mid-migration",
+                    exhausted_outcome="error:migration",
+                )
+                continue
             self._requeue(rr, done, f"replica {h.idx}: {reason}")
 
     def _open_to_half_open(self) -> None:
@@ -805,19 +896,34 @@ class Router:
                 self._flight_note("router_probe", replica=h.idx)
 
     def _requeue(
-        self, rr: RouterRequest, done: list[RouterRequest], why: str
+        self,
+        rr: RouterRequest,
+        done: list[RouterRequest],
+        why: str,
+        *,
+        exhausted_outcome: Optional[str] = None,
     ) -> None:
         """Failover: re-queue ``rr`` on the survivors under the retry
         budget with jittered exponential step-count backoff — or shed it,
-        typed, when the budget (or the fleet) is exhausted."""
-        survivors = [x for x in self.handles if not x.dead]
+        typed, when the budget (or the fleet) is exhausted.
+        ``exhausted_outcome`` overrides the terminal outcome past the
+        budget ("error:migration" for a handoff-interrupted request, so
+        the migration failure mode is distinguishable from overload)."""
+        survivors = [
+            x for x in self.handles if not x.dead and x.role != "decode"
+        ]
         if rr.retries >= self.rcfg.retry_budget or not survivors:
-            self._shed(
-                rr,
+            why = (
                 f"{why}; retries={rr.retries}/{self.rcfg.retry_budget}, "
-                f"survivors={len(survivors)}",
-                done,
+                f"survivors={len(survivors)}"
             )
+            if exhausted_outcome is not None:
+                log.warning(
+                    "request %d: %s -> %s", rr.rid, why, exhausted_outcome
+                )
+                self._finalize(rr, exhausted_outcome, done)
+            else:
+                self._shed(rr, why, done)
             return
         rr.retries += 1
         self.stats.retries += 1
@@ -854,6 +960,8 @@ class Router:
         attempts this request consumed on its way to the outcome."""
         assert not rr.done, (rr.rid, rr.outcome, outcome)
         rr.outcome = outcome
+        self._mig_exhausted.discard(rr.rid)
+        self._mig_failures.pop(rr.rid, None)
         if self._tracer.enabled:
             self._tracer.instant(
                 "outcome", rid=rr.rid, tid=rr.rid, outcome=outcome,
@@ -877,6 +985,15 @@ class Router:
         client-driven terminals (cancelled, expired) are NEUTRAL — they
         say nothing about replica health, so the breaker stays HALF_OPEN
         and the next eligible request becomes the new probe."""
+        st = self._migrations.pop(rr.rid, None)
+        if st is not None:
+            # The source attempt reached a terminal outcome while its
+            # handoff was still staging (completed/expired/cancelled
+            # before the commit): drop the half-shipped staging — the
+            # outcome below is the request's one surfacing.
+            dsth = self.handles[st.dst]
+            if not dsth.dead:
+                dsth.engine.import_abort(st.token)
         was_probe = h.probe_rid == er.rid
         if was_probe:
             h.probe_rid = None
@@ -904,29 +1021,233 @@ class Router:
                 h.state = OPEN
                 h.opened_at = self.step_no
 
+    # -- prefill -> decode KV-page migration (ISSUE 20) --------------------
+
+    def _drive_migrations(self, done: list[RouterRequest]) -> None:
+        """Advance every live handoff after the replica steps: open a
+        stream when a prefill replica finishes a prompt (or, under
+        router.migrate_per_chunk, as soon as its first full page lands),
+        ship page batches, and commit the decode-side admission. Failures
+        are contained per request: the envelope's unwind leaves the
+        source serving colocated, migrations_failed counts the attempt,
+        and past router.retry_budget the request is left alone (typed
+        outcome still guaranteed — it completes on its prefill replica)."""
+        for h in self.handles:
+            if h.role != "prefill" or h.dead:
+                continue
+            for erid, rr in list(h.inflight.items()):
+                if rr.rid in self._mig_exhausted or h.probe_rid == erid:
+                    # A HALF_OPEN prefill probe must complete on its own
+                    # replica — migrating it away would starve the
+                    # breaker of its verdict.
+                    continue
+                try:
+                    self._advance_migration(h, erid, rr, done)
+                except (DispatchFault, MemoryError, InjectedFault) as e:
+                    self._migration_failed(rr, e)
+
+    def _advance_migration(
+        self,
+        h: ReplicaHandle,
+        erid: int,
+        rr: RouterRequest,
+        done: list[RouterRequest],
+    ) -> None:
+        eng = h.engine
+        st = self._migrations.get(rr.rid)
+        if st is not None:
+            dsth = self.handles[st.dst]
+            if dsth.dead or dsth.state == OPEN:
+                # Destination broke since the stream opened: the staging
+                # died with it (_break aborted live ones). Re-open
+                # against a survivor below.
+                self._migrations.pop(rr.rid, None)
+                st = None
+        ready = eng.migration_ready(erid)
+        if st is None:
+            streaming = (
+                self.rcfg.migrate_per_chunk
+                and eng.migration_in_prefill(erid)
+                and eng.migration_full_pages(erid) > 0
+            )
+            if not (ready or streaming):
+                return
+            dst = self._pick_decode()
+            if dst is None:
+                # No routable decode replica: decode colocated on the
+                # prefill replica (graceful degradation, not an error).
+                return
+            token = dst.engine.import_begin(
+                eng.export_migration_state(erid)
+            )
+            st = MigrationStream(
+                src=h.idx, dst=dst.idx, token=token,
+                t0=time.perf_counter(),
+            )
+            self._migrations[rr.rid] = st
+        dst = self.handles[st.dst]
+        # Ship [shipped, stop): the immutable-full-page watermark while
+        # streaming, everything (partial cursor page included) at commit.
+        stop = None if ready else eng.migration_full_pages(erid)
+        if stop is None or stop > st.shipped:
+            self._inject_migration("gather")
+            live, blocks = eng.export_migration_pages(
+                erid, st.shipped, stop
+            )
+            if live:
+                blocks = self._convert_blocks(blocks, dst)
+                self._inject_migration("scatter")
+                dst.engine.import_pages(st.token, live, blocks)
+                st.pages += len(live)
+                st.shipped = max(st.shipped, max(live) + 1)
+        if not ready:
+            return
+        # Atomic commit: re-export the host-side state (the source kept
+        # decoding while the commit waited) and admit as a zero-prefill
+        # warm start. A full destination defers — the request is WHOLLY
+        # arrived, just unscheduled, and the source keeps serving.
+        state = eng.export_migration_state(erid)
+        er_new = dst.engine.import_commit(st.token, state)
+        if er_new is None:
+            return
+        del self._migrations[rr.rid]
+        self._mig_failures.pop(rr.rid, None)
+        h.inflight.pop(erid, None)
+        eng.finish_migration(erid)
+        rr.attempt = er_new
+        rr.replica = dst.idx
+        dst.inflight[er_new.rid] = rr
+        self.stats.migrations += 1
+        latency = time.perf_counter() - st.t0
+        self.migration_latencies.append(latency)
+        if dst.state == HALF_OPEN and dst.probe_rid is None:
+            # A migrated-in request is the decode role's probe: its
+            # typed outcome drives the breaker exactly like a routed
+            # probe on a prefill replica.
+            dst.probe_rid = er_new.rid
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "migrate", rid=rr.rid, tid=rr.rid, src=h.idx,
+                dst=dst.idx, pages=st.pages, cursor=state["cursor"],
+                latency_s=round(latency, 6), step=self.step_no,
+            )
+        self._flight_note(
+            "router_migrate", rid=rr.rid, src=h.idx, dst=dst.idx,
+            pages=st.pages, latency_s=round(latency, 6),
+        )
+
+    def _migration_failed(self, rr: RouterRequest, err: Exception) -> None:
+        """A handoff envelope failed with the SOURCE intact (export is a
+        pure pool read; a torn import freed its fresh pages): abort the
+        stream, count it, retry on a later step — and past
+        router.retry_budget stop trying, leaving the request to complete
+        colocated with its normal typed outcome. Source DEATH mid-stream
+        is _break's path, which re-queues with the typed ``retried`` tag
+        instead."""
+        st = self._migrations.pop(rr.rid, None)
+        if st is not None:
+            dsth = self.handles[st.dst]
+            if not dsth.dead:
+                dsth.engine.import_abort(st.token)
+        self.stats.migrations_failed += 1
+        fails = self._mig_failures.get(rr.rid, 0) + 1
+        self._mig_failures[rr.rid] = fails
+        exhausted = fails > self.rcfg.retry_budget
+        if exhausted:
+            self._mig_exhausted.add(rr.rid)
+            self._mig_failures.pop(rr.rid, None)
+        log.warning(
+            "request %d migration failed (%s): attempt %d/%d%s",
+            rr.rid, err, fails, self.rcfg.retry_budget + 1,
+            ", decoding colocated" if exhausted else "",
+        )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "migrate_fail", rid=rr.rid, tid=rr.rid,
+                error=f"{type(err).__name__}: {err}", attempt=fails,
+                exhausted=exhausted, step=self.step_no,
+            )
+        self._flight_note(
+            "router_migrate_fail", rid=rr.rid,
+            error=f"{type(err).__name__}: {err}", attempt=fails,
+            exhausted=exhausted,
+        )
+
+    def _pick_decode(self) -> Optional[ReplicaHandle]:
+        """Least-loaded routable decode replica (phase-aware _load_key),
+        or None when the whole decode role is down/open — migration then
+        skips and the prefill replica decodes colocated."""
+        cands = [
+            h for h in self.handles
+            if h.role == "decode" and h.routable
+        ]
+        if not cands:
+            return None
+        return min(cands, key=self._load_key)
+
+    def _convert_blocks(self, blocks: dict, dst: ReplicaHandle) -> dict:
+        """Topology conversion for a page-block batch: redistribute
+        straight onto the destination pool's per-array shardings through
+        parallel/reshard.py (block shapes are pool-size independent, so
+        mismatched pool layouts convert naturally); when the destination
+        exposes no usable sharding, fall back to the universal host hop
+        (device_get -> numpy; import_pages re-places on the destination)."""
+        tgt = dst.engine.migration_block_shardings()
+        if tgt is not None:
+            return reshard(blocks, {k: tgt[k] for k in blocks})
+        return jax.device_get(blocks)
+
+    def _inject_migration(self, point: str) -> None:
+        """Consume a router-level "migration" FaultSpec at this envelope
+        stage ("gather" before the source read, "scatter" before the
+        destination write; spec.path restricts the stage). Raises
+        InjectedFault INSIDE the handoff, exercising the
+        whole-or-requeued guarantee through the real unwind paths."""
+        inj = self._injector
+        if inj is None:
+            return
+        spec = inj.take("migration", self.step_no, point)
+        if spec is not None:
+            raise InjectedFault(
+                f"injected migration fault at {point} "
+                f"(router step {self.step_no})"
+            )
+
     # -- placement ---------------------------------------------------------
 
     def _load_key(self, h: ReplicaHandle) -> tuple:
         """Load order for placement tiebreaks, read from the replica's
         metrics registry (never ad-hoc counters): queue depth + active
         slots first, then pool occupancy, then the current window's
-        device-seconds-per-slot-step (the per-class ITL proxy — a replica
-        grinding through slow verify windows ranks below an idle one at
-        equal occupancy). Replica index last for determinism."""
+        PURE-DECODE device-seconds-per-decode-slot-step (the per-class
+        ITL proxy — phase-aware, so a replica grinding through a long
+        prompt no longer looks "slow to decode"; mixed chunk+decode
+        dispatches land in their own registry bucket), then the all-phase
+        gauge as the residual tiebreak (it still sees prefill/mixed
+        grind when the pure-decode gauge is empty or tied). Replica index
+        last for determinism."""
         g = h.engine.registry.snapshot(sections=("engine", "pool"))
         queued = g.get("engine.waiting", 0) + g.get("engine.active", 0)
         occupancy = g.get("pool.occupancy", 0.0)
-        itl = g.get("engine.device_s", 0.0) / max(
+        itl = g.get("engine.decode_device_s", 0.0) / max(
+            g.get("engine.decode_slot_steps", 0), 1
+        )
+        itl_all = g.get("engine.device_s", 0.0) / max(
             g.get("engine.slot_steps", 0), 1
         )
-        return (queued, occupancy, itl, h.idx)
+        return (queued, occupancy, itl, itl_all, h.idx)
 
     def _place(self, rr: RouterRequest):
         """(handle, affinity, match_tokens) for the best placement right
         now, or None when no replica is routable. Longest radix match >=
         affinity_min_tokens wins (load breaks ties among equal matches);
         otherwise least-loaded."""
-        cands = [h for h in self.handles if h.routable]
+        # Decode-role replicas accept only migrated-in work (ISSUE 20):
+        # new submissions and failover re-placements go to prefill
+        # replicas, whose radix trees the affinity probe is restricted to.
+        cands = [
+            h for h in self.handles if h.routable and h.role != "decode"
+        ]
         if not cands:
             return None
         matches = {
@@ -1009,13 +1330,14 @@ class Router:
             # postmortem note. Recorded only when the flight recorder —
             # its sole consumer — exists, so an obs-off fleet pays no
             # extra registry read per placement.
-            queued, occupancy, itl, _ = load_key
+            queued, occupancy, itl, itl_all, _ = load_key
             self._decisions.append({
                 "step": self.step_no, "rid": rr.rid, "replica": h.idx,
                 "match_tokens": match, "affinity": affinity,
                 "retried": rr.retries, "queued": queued,
                 "occupancy": round(float(occupancy), 4),
                 "itl_proxy_s": round(float(itl), 6),
+                "itl_all_s": round(float(itl_all), 6),
             })
         if self._tracer.enabled:
             self._tracer.instant(

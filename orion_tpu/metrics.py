@@ -339,7 +339,12 @@ class RouterStats:
     ``kills`` the replica_kill subset, ``probes`` OPEN->HALF_OPEN
     transitions, ``recoveries`` probes that closed the breaker. SLO
     (ISSUE 14): ``slo_breaches`` counts typed ``slo_breach`` events the
-    burn-rate monitor (obs/slo.py) fired this window.
+    burn-rate monitor (obs/slo.py) fired this window. Disaggregation
+    (ISSUE 20): ``migrations`` counts completed prefill->decode KV-page
+    handoffs, ``migrations_failed`` envelopes that faulted (gather/
+    convert/scatter), ``migrations_requeued`` the subset whose request
+    was re-queued on a prefill replica with a ``retried`` tag (the rest
+    of the failures stayed resident on their source replica).
     """
 
     routed: int = 0
@@ -352,6 +357,9 @@ class RouterStats:
     probes: int = 0
     recoveries: int = 0
     slo_breaches: int = 0
+    migrations: int = 0
+    migrations_failed: int = 0
+    migrations_requeued: int = 0
 
     def as_timing(self) -> dict[str, float]:
         return {
@@ -365,6 +373,9 @@ class RouterStats:
             "probes": self.probes,
             "recoveries": self.recoveries,
             "slo_breaches": self.slo_breaches,
+            "migrations": self.migrations,
+            "migrations_failed": self.migrations_failed,
+            "migrations_requeued": self.migrations_requeued,
         }
 
 

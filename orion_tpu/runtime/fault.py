@@ -311,6 +311,15 @@ class FaultSpec:
         pages were allocated and the in-flight host refs taken —
         exercising the envelope's full unwind (both pools balanced, tree
         markers unpromoted, typed DispatchFault fails the step).
+      - "migration": the next KV-page migration envelope (ISSUE 20;
+        ``step`` is the ROUTER step number) raises InjectedFault inside
+        the gather/convert/scatter copy — after the source gather but
+        before the destination admission commits — exercising the
+        whole-or-requeued guarantee: the request must end wholly on the
+        decode replica or re-queued on a surviving prefill replica with
+        a typed ``retried`` outcome, never half a context. ``path``
+        optionally restricts to one envelope stage ("gather" |
+        "scatter").
 
     Training-path kinds (Trainer(..., fault_injector=...); ``step`` is the
     trainer step, ``path`` is "train"):
@@ -365,7 +374,7 @@ class FaultSpec:
     def __post_init__(self):
         if self.kind not in (
             "dispatch", "nan", "pool", "stall", "partial_write",
-            "restore",
+            "restore", "migration",
         ) + self.REPLICA_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.count < 1:

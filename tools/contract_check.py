@@ -82,6 +82,20 @@ GRID_VARIANTS: dict = {
     "mixed_hygiene": [
         ["inference.kv_quant=int8"],
     ],
+    # The migration envelope across the kv_quant/SWA grid (ISSUE 20):
+    # int8 adds the f32 scale pools to the copied tree, a sliding window
+    # changes which logical pages exist — neither may change the copy
+    # programs' hygiene.
+    "migration_hygiene": [
+        ["inference.kv_quant=int8"],
+        ["model.sliding_window=32"],
+        ["inference.kv_quant=int8", "model.sliding_window=32"],
+    ],
+    "migration_scatter_hygiene": [
+        ["inference.kv_quant=int8"],
+        ["model.sliding_window=32"],
+        ["inference.kv_quant=int8", "model.sliding_window=32"],
+    ],
     "long_prefill_hygiene": [
         ["inference.kv_quant=int8"],
         # The paged-flash prefill body, interpret-lowered on CPU: the

@@ -33,9 +33,23 @@ tracer on for the BASELINE run: its wall_s/tokens_per_step against a
 plain ``--smoke`` run is the router-path tracer-overhead measurement
 (PERF.md "Tracer overhead").
 
+``--disagg`` (ISSUE 20) switches to the disaggregated-serving bench:
+a role-split fleet (``router.roles``) vs the colocated fleet at EQUAL
+replica count under a prompt burst. Measured streams decode while a
+burst of long prompts prefills; decode ITL is taken on per-replica
+VIRTUAL clocks (each replica advances only by its own compute, the way
+parallel fleet hardware would — the in-process router steps replicas
+serially, so wall-clock gaps would charge every replica for the whole
+fleet's work). The pins: role-split decode ITL p99 strictly below
+colocated, every request KV-migrated exactly once (latency percentiles
+reported), decode replicas NEVER run prompt prefill, and a killed
+prefill replica mid-burst leaves every request wholly-arrived or
+re-queued with a typed outcome — never half a context.
+
     python tools/router_bench.py            # on-chip numbers
     python tools/router_bench.py --smoke    # tiny CPU logic check
     python tools/router_bench.py --smoke --trace   # tracer-overhead row
+    python tools/router_bench.py --disagg --smoke  # disagg pin (tier-1)
 """
 import sys as _sys, pathlib as _pathlib
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parent.parent))
@@ -262,6 +276,362 @@ def _check_merged_trace(path, replicas, rids, retried_rids):
     return out
 
 
+# -- Disaggregated prefill/decode serving (ISSUE 20) ------------------------
+
+def _busy_s(engine) -> float:
+    """Reading of one replica's private compute clock: every second the
+    engine has spent serving since its last ``reset_timing`` — device
+    dispatches, admission prefill, host scheduling, tier copies and the
+    migration gather/scatter envelopes. Per-router-step DELTAS of this
+    drive the virtual clocks the disagg ITL measurement runs on."""
+    t = engine.timing
+    return (
+        t["device_s"] + t["prefill_s"] + t["host_s"] + t["spill_s"]
+        + t["restore_s"] + t["page_in_s"] + t["migrate_out_s"]
+        + t["migrate_in_s"]
+    )
+
+
+def _trim_spikes(samples: list, factor: float = 12.0, frac: float = 0.05):
+    """Drop stray OS-preemption spikes from an ITL sample set: on a
+    shared/1-cpu CI box another process time-slicing the bench inflates a
+    handful of busy-span samples by 30-100x, and at p99 one such sample IS
+    the percentile in both modes — the verdict then compares scheduler
+    noise, not serving behaviour. A sample is a spike only above
+    ``factor``x the nonzero median, and trimming happens only when spikes
+    are at most ``frac`` of all samples: a SYSTEMATIC slowdown (e.g. a
+    per-migration compile on the decode clock — the regression class this
+    bench exists to catch: every migrated stream carries one) contaminates
+    well above that fraction and is kept in the tail. Returns
+    ``(samples, n_trimmed)``."""
+    nonzero = sorted(s for s in samples if s > 0.0)
+    if not nonzero:
+        return samples, 0
+    cut = factor * nonzero[len(nonzero) // 2]
+    spikes = sum(1 for s in samples if s > cut)
+    if 0 < spikes <= max(1, int(frac * len(samples))):
+        return [s for s in samples if s <= cut], spikes
+    return samples, 0
+
+
+def _disagg_workload(n_decoders, n_burst, decoder_tokens, burst_tokens):
+    """Two waves, all prompts distinct (no prefix sharing — the bench
+    isolates the prefill-interference effect, not cache affinity):
+    ``wave1`` short prompts whose decode ITL is the measurement, ``wave2``
+    the long-prompt burst that floods prefill mid-decode."""
+    wave1 = [
+        [(11 * i + 3 * j) % 241 + 1 for j in range(decoder_tokens)]
+        for i in range(n_decoders)
+    ]
+    wave2 = [
+        [(7 * i + 5 * j) % 239 + 2 for j in range(burst_tokens)]
+        for i in range(n_burst)
+    ]
+    return wave1, wave2
+
+
+def _run_disagg(cfg, params, wave1, wave2, max_new1, max_new2,
+                kill_step=None, prime=(), label="colocated"):
+    """Serve the two-wave burst through a fresh fleet; wave-1 decode ITL
+    on per-replica virtual clocks plus migration latency percentiles.
+
+    Clean runs submit wave 1 first and fire the burst once every measured
+    stream is decoding (>= 2 tokens); the chaos run submits both waves
+    together so the prefill replicas are deterministically mid-burst (and,
+    under ``router.migrate_per_chunk``, mid-stream) at ``kill_step``. An
+    ITL interval is dropped when a stream changes replica between tokens
+    (source and destination clocks are not comparable); everything else
+    is charged to the serving replica's own clock."""
+    from orion_tpu.infer import Router
+    from orion_tpu.metrics import LatencyStats
+    from orion_tpu.obs import bench_metrics_block
+    from orion_tpu.runtime.fault import FaultInjector, FaultSpec
+
+    inj = None
+    if kill_step is not None:
+        inj = FaultInjector(
+            [FaultSpec("replica_kill", step=kill_step, replica=0)]
+        )
+    router = Router(cfg, params, fault_injector=inj)
+    if prime:
+        # Compile every dispatch family (and, on a role-split fleet, the
+        # migration gather/convert/scatter programs) BEFORE the measured
+        # window, then zero every clock the measurement reads.
+        for pr in prime:
+            router.submit_request(pr, 2)
+        while router.has_work():
+            router.step()
+        router.reset_timing()
+        for h in router.handles:
+            h.engine.reset_timing()
+        router.step_no = 0
+    t0 = time.perf_counter()
+    reqs1 = [router.submit_request(p, max_new1) for p in wave1]
+    reqs2 = (
+        [router.submit_request(p, max_new2) for p in wave2]
+        if kill_step is not None else []
+    )
+    vt = {h.idx: 0.0 for h in router.handles}
+    seen: dict = {}
+    last_vt: dict = {}
+    last_rep: dict = {}
+    itl_samples: list = []
+    finished = []
+    burst_step = 0 if reqs2 else None
+    killed_inflight = None
+    while router.has_work() or not reqs2:
+        if not reqs2 and all(
+            len(rr.generated) >= 2 or rr.outcome for rr in reqs1
+        ):
+            reqs2 = [router.submit_request(p, max_new2) for p in wave2]
+            burst_step = router.step_no
+            continue
+        if (
+            kill_step is not None and killed_inflight is None
+            and router.step_no == kill_step
+        ):
+            killed_inflight = [
+                rr.rid for rr in router.handles[0].inflight.values()
+            ]
+        before = {h.idx: _busy_s(h.engine) for h in router.handles}
+        done = router.step()
+        for h in router.handles:
+            vt[h.idx] += _busy_s(h.engine) - before[h.idx]
+        for rr in reqs1:
+            n = len(rr.generated)
+            prev = seen.get(rr.rid, 0)
+            if n > prev:
+                rep = rr.replica
+                if rep is not None:
+                    arrival = vt[rep]
+                    if rr.rid in last_vt and last_rep.get(rr.rid) == rep:
+                        itl_samples.append(
+                            max(arrival - last_vt[rr.rid], 0.0)
+                        )
+                        itl_samples.extend([0.0] * (n - prev - 1))
+                    last_vt[rr.rid] = arrival
+                    last_rep[rr.rid] = rep
+                seen[rr.rid] = n
+        finished.extend((rr.rid, rr.outcome) for rr in done)
+    wall_s = time.perf_counter() - t0
+    itl_samples, itl_trimmed = _trim_spikes(itl_samples)
+    itl = LatencyStats()
+    for s in itl_samples:
+        itl.record(s)
+    mig_lat = LatencyStats()
+    for s in router.migration_latencies:
+        mig_lat.record(s)
+    router.close()
+    reqs = reqs1 + reqs2
+    outcomes: dict[str, int] = {}
+    for rr in reqs:
+        outcomes[rr.outcome or "MISSING"] = (
+            outcomes.get(rr.outcome or "MISSING", 0) + 1
+        )
+    per_replica = []
+    for h in router.handles:
+        t = h.engine.reset_timing()
+        per_replica.append({
+            "replica": h.idx,
+            "role": h.role,
+            "dead": h.dead,
+            "state": h.state,
+            "metrics": bench_metrics_block(h.engine, timing=t),
+        })
+    out = {
+        "mode": label,
+        "roles": cfg.router.roles or "",
+        "replicas": cfg.router.replicas,
+        "requests": len(reqs),
+        "wall_s": round(wall_s, 3),
+        "router_steps": router.step_no,
+        "burst_step": burst_step,
+        "kill_step": kill_step,
+        "outcomes": outcomes,
+        "decode_itl": {k: round(v, 5) for k, v in itl.summary().items()},
+        "itl_trimmed": itl_trimmed,
+        "migration_latency": {
+            k: round(v, 5) for k, v in mig_lat.summary().items()
+        },
+        "router": router.reset_timing(),
+        "per_replica": per_replica,
+    }
+    records = {
+        "reqs1": reqs1,
+        "reqs2": reqs2,
+        "finished": finished,
+        "killed_inflight": killed_inflight or [],
+    }
+    return out, records
+
+
+def disagg_main(args) -> int:
+    """Colocated vs role-split fleet at equal replica count, plus the
+    kill-a-prefill-worker chaos run; one JSON line per run + a verdict."""
+    import dataclasses
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    from orion_tpu.config import get_config
+    from orion_tpu.infer import InferenceEngine
+    from orion_tpu.models import init_params
+
+    # prefill_chunk_tokens=16 makes admission genuinely incremental: the
+    # burst's prompt tokens drip through the mixed dispatch for many
+    # steps, so colocated decode streams ride chunk-carrying dispatches
+    # (the interference under test) while role-split decode replicas
+    # never see a prompt token.
+    overrides = [
+        "inference.max_seq_len=256",
+        "inference.page_size=16",
+        "inference.num_pages=96",
+        "inference.max_batch_size=8",
+        "inference.prefill_chunk=16",
+        "inference.chunked_prefill=true",
+        "inference.prefill_chunk_tokens=16",
+        "inference.decode_window=2",
+        f"router.replicas={args.replicas}",
+    ]
+    cfg = get_config(args.preset, overrides)
+    params = init_params(cfg.model, jax.random.key(0))
+    n_dec, n_burst = (4, 6) if args.smoke else (4, 8)
+    burst_tokens = 32 if args.smoke else 64
+    # Wave-1 length sizes the ITL sample set (~n_dec x max_new1
+    # intervals): at 60 samples nearest-rank p99 IS the max and one
+    # stray OS-preemption slice decides the A/B verdict; ~250 samples
+    # put p99 at the 3rd-largest so isolated noise falls past the
+    # percentile in both modes (_trim_spikes handles the gross ones).
+    max_new1 = 64 if args.smoke else args.max_new
+    max_new2 = 6 if args.smoke else 8
+    wave1, wave2 = _disagg_workload(n_dec, n_burst, 8, burst_tokens)
+
+    # Uninterrupted single-engine reference: the byte-identity bar for
+    # every completed greedy stream in every fleet mode.
+    ref_eng = InferenceEngine(cfg, params)
+    ref1 = ref_eng.generate(wave1, max_new1)
+    ref2 = ref_eng.generate(wave2, max_new2)
+
+    split_cfg = dataclasses.replace(
+        cfg, router=dataclasses.replace(
+            cfg.router, roles=f"prefill:1,decode:{args.replicas - 1}"
+        )
+    )
+    # Chaos keeps 2 prefill replicas so the killed one's requests have a
+    # surviving same-role home to re-queue on, and streams pages per
+    # chunk so the kill lands MID-migration, not between envelopes.
+    chaos_cfg = dataclasses.replace(
+        cfg, router=dataclasses.replace(
+            cfg.router, roles=f"prefill:2,decode:{args.replicas - 2}",
+            migrate_per_chunk=True,
+        )
+    )
+
+    # Prime with the MEASURED workload itself (at max_new=2): every
+    # dispatch family compiles at the exact batch/chunk shapes the
+    # measured window reaches — on a role-split fleet that includes the
+    # migration gather/scatter programs at their real page-batch shapes.
+    prime = wave1 + wave2
+    coloc, coloc_rec = _run_disagg(
+        cfg, params, wave1, wave2, max_new1, max_new2,
+        prime=prime, label="colocated",
+    )
+    print(json.dumps(coloc), flush=True)
+    split, split_rec = _run_disagg(
+        split_cfg, params, wave1, wave2, max_new1, max_new2,
+        prime=prime, label="split",
+    )
+    print(json.dumps(split), flush=True)
+    chaos, chaos_rec = _run_disagg(
+        chaos_cfg, params, wave1, wave2, max_new1, max_new2,
+        kill_step=args.kill_step, label="split_chaos",
+    )
+    print(json.dumps(chaos), flush=True)
+
+    def check(rec):
+        reqs = rec["reqs1"] + rec["reqs2"]
+        rid_counts: dict[int, int] = {}
+        for rid, _ in rec["finished"]:
+            rid_counts[rid] = rid_counts.get(rid, 0) + 1
+        all_typed = all(rr.outcome for rr in reqs)
+        no_duplicates = all(c == 1 for c in rid_counts.values())
+        no_silent_drops = sorted(rid_counts) == sorted(
+            rr.rid for rr in reqs
+        )
+        byte_identical = all(
+            list(rr.generated) == ref1[i]
+            for i, rr in enumerate(rec["reqs1"])
+            if rr.outcome == "completed"
+        ) and all(
+            list(rr.generated) == ref2[i]
+            for i, rr in enumerate(rec["reqs2"])
+            if rr.outcome == "completed"
+        )
+        return all_typed, no_duplicates, no_silent_drops, byte_identical
+
+    co_typed, co_dup, co_drop, co_bytes = check(coloc_rec)
+    sp_typed, sp_dup, sp_drop, sp_bytes = check(split_rec)
+    ch_typed, ch_dup, ch_drop, ch_bytes = check(chaos_rec)
+    by_rid = {
+        rr.rid: rr for rr in chaos_rec["reqs1"] + chaos_rec["reqs2"]
+    }
+    whole_or_requeued = all(
+        by_rid[rid].outcome in ("completed", "shed", "error:migration")
+        for rid in chaos_rec["killed_inflight"]
+    )
+    decode_clean = all(
+        r["metrics"].get("serve.chunk_tokens", 0) == 0
+        and r["metrics"].get("serve.mixed_steps", 0) == 0
+        for r in split["per_replica"] if r["role"] == "decode"
+    )
+    verdict = {
+        "verdict": True,
+        "colocated_all_typed": co_typed and co_dup and co_drop,
+        "colocated_byte_identical": co_bytes,
+        "split_all_typed": sp_typed and sp_dup and sp_drop,
+        "split_byte_identical": sp_bytes,
+        "split_all_migrated": (
+            split["router"]["migrations"] == split["requests"]
+        ),
+        "split_zero_migration_failures": (
+            split["router"]["migrations_failed"] == 0
+        ),
+        "split_decode_replicas_never_prefill": decode_clean,
+        "split_itl_p99_better": (
+            split["decode_itl"]["p99"] < coloc["decode_itl"]["p99"]
+        ),
+        "migration_latency_measured": (
+            split["migration_latency"]["count"]
+            == split["router"]["migrations"]
+            and split["migration_latency"]["max"] > 0.0
+        ),
+        "chaos_all_typed": ch_typed,
+        "chaos_no_duplicates": ch_dup,
+        "chaos_no_silent_drops": ch_drop,
+        "chaos_streams_byte_identical": ch_bytes,
+        "chaos_kill_observed": len(chaos_rec["killed_inflight"]) > 0,
+        "chaos_whole_or_requeued": whole_or_requeued,
+        "chaos_killed_inflight": len(chaos_rec["killed_inflight"]),
+        "chaos_migrations": chaos["router"]["migrations"],
+        "chaos_migrations_failed": chaos["router"]["migrations_failed"],
+        "chaos_migrations_requeued": (
+            chaos["router"]["migrations_requeued"]
+        ),
+        "itl_p99_colocated_s": coloc["decode_itl"]["p99"],
+        "itl_p99_split_s": split["decode_itl"]["p99"],
+    }
+    verdict["verdict"] = all(
+        v for k, v in verdict.items()
+        if isinstance(v, bool) and k != "verdict"
+    )
+    print(json.dumps(verdict), flush=True)
+    if args.smoke and not verdict["verdict"]:
+        failed = [k for k, v in verdict.items()
+                  if isinstance(v, bool) and not v and k != "verdict"]
+        print(f"SMOKE FAIL: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--smoke", action="store_true",
@@ -283,7 +653,14 @@ def main(argv=None) -> int:
     p.add_argument("--recovery-bound", type=int, default=16,
                    help="max router steps after the kill for throughput "
                         "to recover to 2/3 of baseline")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated-serving bench (ISSUE 20): "
+                        "colocated vs role-split fleet under a prompt "
+                        "burst + kill-a-prefill-worker chaos")
     args = p.parse_args(argv)
+
+    if args.disagg:
+        return disagg_main(args)
 
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")

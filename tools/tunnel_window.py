@@ -98,6 +98,14 @@ QUEUE = [
     # constrained output, on real chips (the --smoke twin rides tier-1).
     ("constrained",
      [sys.executable, str(ROOT / "tools/constrain_bench.py")], 1800),
+    # Disaggregated prefill/decode serving (ISSUE 20): role-split fleet
+    # vs colocated under a prompt burst on real chips — decode ITL
+    # p50/p95/p99, migration latency percentiles off the real d2d/host
+    # hop, and the kill-a-prefill-worker whole-or-requeued verdict (the
+    # --disagg --smoke twin rides tier-1).
+    ("disagg",
+     [sys.executable, str(ROOT / "tools/router_bench.py"),
+      "--disagg"], 1800),
     # Tiered prefix cache (ISSUE 18): device-warm vs host-warm vs
     # recompute TTFT across shrinking HBM pools, with the REAL d2h/h2d
     # bandwidth measured from the spill/restore copy spans — those two
